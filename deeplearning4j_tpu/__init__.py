@@ -1,0 +1,27 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/pallas re-design of the capabilities of early
+DeepLearning4j (reference: everpeace/deeplearning4j): configurable
+multi-layer networks (dense, RBM/DBN with CD-k pretraining, denoising
+autoencoders, conv+pool, LSTM), a full convex-optimizer family
+(SGD / conjugate gradient / L-BFGS / stochastic Hessian-free with
+backtracking line search), data pipelines, evaluation, an NLP stack
+(Word2Vec / GloVe / ParagraphVectors / RNTN), t-SNE + clustering, and —
+in place of the reference's Akka/Hazelcast/Spark/YARN parameter-averaging
+runtimes — idiomatic SPMD data parallelism over a `jax.sharding.Mesh`
+with XLA collectives.
+
+Design principles (vs the Java reference):
+- Mutable ``Model``/``Layer`` object graphs become pure functions over
+  pytree parameter dicts; ``Layer.paramTable()`` maps onto named-array
+  pytrees and ``Gradient``'s keyed table is simply the cotangent pytree.
+- Everything on the compute path is jit-compatible: static shapes,
+  ``lax.scan``/``lax.while_loop`` control flow, threaded PRNG keys.
+- Distribution is in-graph: the reference's parameter-averaging master/
+  worker machinery collapses into a pjit'd train step with ``psum`` over
+  ICI; local-SGD-with-averaging is kept as a faithful compatibility mode.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu import dtypes  # noqa: F401

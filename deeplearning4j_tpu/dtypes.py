@@ -1,0 +1,62 @@
+"""Global dtype policy.
+
+The reference forces ``-Ddtype=float`` (float32) for all tests
+(reference: pom.xml:178-182).  On TPU the idiomatic split is:
+parameters and accumulations in float32, matmul/conv inputs in
+bfloat16 so they hit the MXU at full rate.  The policy object makes
+that explicit and switchable per-model.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy: where params live, what compute runs in, what accumulates."""
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    accum_dtype: jnp.dtype = jnp.float32
+
+    def cast_to_compute(self, x):
+        return jnp.asarray(x, self.compute_dtype)
+
+    def cast_to_param(self, x):
+        return jnp.asarray(x, self.param_dtype)
+
+
+#: float32 everywhere — matches the reference's forced float32 test dtype.
+FLOAT32 = Policy()
+
+#: bfloat16 compute with float32 params/accumulation — the TPU fast path:
+#: bf16 operands stream into the MXU at 2x the f32 rate while the systolic
+#: array accumulates in f32 internally.
+MIXED_BF16 = Policy(compute_dtype=jnp.bfloat16)
+
+_current = FLOAT32
+
+
+def get_policy() -> Policy:
+    return _current
+
+
+def set_policy(policy: Policy) -> None:
+    global _current
+    _current = policy
+
+
+@contextlib.contextmanager
+def policy(p: Policy) -> Iterator[Policy]:
+    global _current
+    prev = _current
+    _current = p
+    try:
+        yield p
+    finally:
+        _current = prev

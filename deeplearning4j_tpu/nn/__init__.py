@@ -1,0 +1,1 @@
+"""Neural-network core: configs, activations, losses, weight init, layers."""

@@ -1,0 +1,122 @@
+"""Activation-function registry.
+
+The reference consumes ND4J's ``Activations`` factory by *name* (names are
+serialized into the network JSON, reference:
+nn/conf/deserializers/ActivationFunctionDeSerializer.java:26-27).  Here the
+registry maps those same names onto jittable ``jnp`` functions; an
+activation in a config is just its string name, which keeps the JSON
+round-trip trivial and the functions fusable by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+ActivationFn = Callable[[jax.Array], jax.Array]
+
+_REGISTRY: dict[str, ActivationFn] = {}
+
+
+def register(name: str) -> Callable[[ActivationFn], ActivationFn]:
+    def deco(fn: ActivationFn) -> ActivationFn:
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get(name: str) -> ActivationFn:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("leakyrelu")
+def leakyrelu(x):
+    return jax.nn.leaky_relu(x)
+
+
+@register("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("linear")
+def linear(x):
+    return x
+
+
+@register("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@register("softmax")
+def softmax(x):
+    # Row-wise softmax over the feature axis, numerically stabilized.
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("rounded")
+def rounded(x):
+    return jnp.round(x)
+
+
+def derivative(name: str, activated: jax.Array) -> jax.Array:
+    """Derivative expressed in terms of the *activated* value.
+
+    The reference's backprop applies f'(z) via the activation's
+    ``applyDerivative`` on post-activation values (e.g.
+    MultiLayerNetwork.computeDeltas, reference:
+    nn/multilayer/MultiLayerNetwork.java:629-687).  Autodiff makes this
+    unnecessary on the main path; it is kept for the hand-rolled solvers
+    and for parity tests.
+    """
+    if name == "sigmoid":
+        return activated * (1.0 - activated)
+    if name == "tanh":
+        return 1.0 - activated**2
+    if name == "hardtanh":
+        return ((activated > -1.0) & (activated < 1.0)).astype(activated.dtype)
+    if name == "relu":
+        return (activated > 0.0).astype(activated.dtype)
+    if name == "linear":
+        return jnp.ones_like(activated)
+    if name == "softmax":
+        return activated * (1.0 - activated)
+    raise ValueError(f"No closed-form derivative registered for {name!r}")

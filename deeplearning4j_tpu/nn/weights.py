@@ -1,0 +1,76 @@
+"""Weight-initialization schemes.
+
+Parity with the reference's ``WeightInit`` menu (reference:
+nn/weights/WeightInit.java:16 — VI, ZERO, SIZE, DISTRIBUTION, NORMALIZED,
+UNIFORM; semantics in nn/weights/WeightInitUtil.java:56-90), re-expressed
+over functional PRNG keys so initialization is reproducible and
+parallelizable (the reference hard-codes a MersenneTwister(123) for some
+schemes; here every scheme takes an explicit key).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes
+
+#: The scheme names (string-valued in configs for trivial JSON serde).
+SCHEMES = ("vi", "zero", "size", "distribution", "normalized", "uniform")
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: str = "vi",
+    dist: tuple[str, float, float] | None = None,
+    dtype=None,
+) -> jax.Array:
+    """Initialize a weight tensor.
+
+    Args:
+      key: PRNG key.
+      shape: tensor shape; fan-in is ``shape[0]``, fan-out ``shape[1]``
+        (matching WeightInitUtil's row/column convention).
+      scheme: one of SCHEMES (case-insensitive).
+      dist: for ``distribution``: ("normal"|"uniform", a, b) where
+        normal=(mean, std), uniform=(low, high).
+      dtype: overrides the active dtype policy's param dtype.
+    """
+    dtype = dtype or dtypes.get_policy().param_dtype
+    scheme = scheme.lower()
+    shape = tuple(int(s) for s in shape)
+    fan_in = shape[0]
+    fan_out = shape[1] if len(shape) > 1 else shape[0]
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "normalized":
+        # rand(shape) - 0.5 / fan_in   (WeightInitUtil.java:62-64)
+        return (jax.random.uniform(key, shape, dtype) - 0.5) / fan_in
+    if scheme == "uniform":
+        # U(-1/fan_in, 1/fan_in)       (WeightInitUtil.java:65-67)
+        a = 1.0 / fan_in
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if scheme == "vi":
+        # Glorot-style: U(-r, r), r = sqrt(6)/sqrt(sum(shape)+1)
+        # (WeightInitUtil.java:69-77)
+        r = math.sqrt(6.0) / math.sqrt(sum(shape) + 1)
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if scheme == "size":
+        # U(-4*sqrt(6/(fan_in+fan_out)), +) (WeightInitUtil.java:36-41)
+        r = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-r, maxval=r)
+    if scheme == "distribution":
+        if dist is None:
+            dist = ("normal", 0.0, 0.01)
+        kind, a, b = dist
+        if kind == "normal":
+            return a + b * jax.random.normal(key, shape, dtype)
+        if kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, minval=a, maxval=b)
+        raise ValueError(f"Unknown distribution kind {kind!r}")
+    raise ValueError(f"Unknown weight init scheme {scheme!r}; known: {SCHEMES}")

@@ -1,0 +1,32 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths
+are exercised without a TPU pod — the same fake-cluster trick the
+reference uses (embedded Hazelcast / IRUnitDriver / Spark local[8],
+reference: scaleout/testsupport/BaseTestDistributed.java:16-80,
+irunit/IRUnitDriver.java:34, BaseSparkTest.java:32-38), re-expressed as
+``--xla_force_host_platform_device_count``.
+
+Must run before jax initializes its backend, hence env mutation at import
+time in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,96 @@
+"""Config serde tests ≙ reference NeuralNetConfigurationTest /
+MultiLayerNeuralNetConfigurationTest (JSON round-trip)."""
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations, conf, losses, weights
+from deeplearning4j_tpu import rng
+
+
+def test_layer_config_json_roundtrip():
+    c = conf.LayerConfig(
+        layer_type="rbm",
+        n_in=784,
+        n_out=500,
+        activation="tanh",
+        momentum_after={10: 0.9, 20: 0.99},
+        visible_unit=conf.VisibleUnit.GAUSSIAN,
+        hidden_unit=conf.HiddenUnit.RECTIFIED,
+        k=3,
+        dist=("normal", 0.0, 0.01),
+        weight_init="distribution",
+    )
+    c2 = conf.LayerConfig.from_json(c.to_json())
+    assert c2 == c
+
+
+def test_multilayer_config_json_roundtrip():
+    mc = conf.list_builder(
+        conf.LayerConfig(activation="tanh", lr=1e-2),
+        sizes=[3, 2],
+        n_in=4,
+        n_out=3,
+        hidden_layer_type="rbm",
+    )
+    mc2 = conf.MultiLayerConfig.from_json(mc.to_json())
+    assert mc2 == mc
+    assert mc.n_layers == 3
+    assert mc.confs[0].n_in == 4 and mc.confs[0].n_out == 3
+    assert mc.confs[1].n_in == 3 and mc.confs[1].n_out == 2
+    assert mc.confs[2].layer_type == "output"
+    assert mc.confs[2].n_in == 2 and mc.confs[2].n_out == 3
+
+
+def test_list_builder_overrides():
+    mc = conf.list_builder(
+        conf.LayerConfig(),
+        sizes=[5],
+        n_in=4,
+        n_out=3,
+        overrides={0: lambda c: c.replace(lr=0.5), 1: lambda c: c.replace(loss="MSE")},
+    )
+    assert mc.confs[0].lr == 0.5
+    assert mc.confs[1].loss == "MSE"
+
+
+def test_activation_registry():
+    x = jnp.array([-2.0, 0.0, 2.0])
+    for name in activations.names():
+        y = activations.get(name)(x)
+        assert y.shape == x.shape
+    s = activations.get("softmax")(jnp.ones((2, 3)))
+    assert jnp.allclose(s.sum(-1), 1.0)
+
+
+def test_losses_basic():
+    labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    good = jnp.array([[0.9, 0.1], [0.1, 0.9]])
+    bad = jnp.array([[0.1, 0.9], [0.9, 0.1]])
+    for name in losses.names():
+        lg = losses.get(name)(labels, good)
+        assert jnp.isfinite(lg)
+    assert losses.get("MCXENT")(labels, good) < losses.get("MCXENT")(labels, bad)
+    assert losses.get("MSE")(labels, good) < losses.get("MSE")(labels, bad)
+
+
+def test_fused_logits_loss_matches_unfused():
+    import jax
+
+    labels = jnp.array([[1.0, 0.0, 0.0], [0.0, 0.0, 1.0]])
+    logits = jnp.array([[2.0, -1.0, 0.3], [0.1, 0.2, 1.5]])
+    fused = losses.logits_loss("MCXENT", labels, logits)
+    unfused = losses.get("MCXENT")(labels, jax.nn.softmax(logits, -1))
+    assert jnp.allclose(fused, unfused, atol=1e-4)
+
+
+def test_weight_init_schemes():
+    ks = rng.KeyStream(0)
+    for scheme in weights.SCHEMES:
+        w = weights.init_weights(ks.next(), (64, 32), scheme)
+        assert w.shape == (64, 32)
+        if scheme == "zero":
+            assert jnp.all(w == 0)
+        else:
+            assert jnp.std(w) > 0
+    wn = weights.init_weights(ks.next(), (1000, 10), "normalized")
+    assert abs(float(wn.mean())) < 1e-3  # centered, scaled by 1/fan_in
