@@ -1,0 +1,25 @@
+"""Layer registry.
+
+Layers are *modules of pure functions* over pytree parameter dicts — the
+TPU-native re-design of the reference's mutable ``Layer`` objects
+(reference: nn/api/Layer.java:18).  ``Layer.paramTable()``'s string-keyed
+INDArray map becomes the params dict; ``Gradient``'s keyed table is just
+the cotangent pytree returned by ``jax.grad``.
+
+Registry ≙ the reference's ``LayerFactories.getFactory`` reflective
+dispatch (nn/layers/factory/LayerFactories.java:33), keyed by the
+``layer_type`` string in ``LayerConfig``.
+"""
+
+from deeplearning4j_tpu.nn.layers import api as api  # noqa: F401
+from deeplearning4j_tpu.nn.layers.api import get, names, register  # noqa: F401
+
+# Import layer modules for their registration side effects.
+from deeplearning4j_tpu.nn.layers import (  # noqa: F401
+    autoencoder,
+    convolution,
+    dense,
+    lstm,
+    output,
+    rbm,
+)

@@ -1,0 +1,112 @@
+"""Layer module protocol + shared helpers.
+
+A layer module provides (all pure, jit-compatible):
+
+- ``init(key, conf) -> params``          parameter pytree (dict of arrays)
+- ``pre_output(params, conf, x)``        affine part (≙ BaseLayer.preOutput,
+                                         reference: nn/layers/BaseLayer.java:159-178)
+- ``activate(params, conf, x, key=None, training=False)``
+                                         f(pre_output) + dropout
+                                         (≙ BaseLayer.activate:187-198)
+- ``score(params, conf, x, key)``        unsupervised objective for
+                                         pretrain layers (lower is better)
+- ``gradient(params, conf, x, key) -> (score, grads)``
+                                         defaults to value_and_grad(score);
+                                         RBM overrides with CD-k statistics
+                                         (not a plain gradient).
+
+Param keys reuse the reference's names (W, b, vb, recurrentweights,
+decoderweights, decoderbias, convweights, convbias — reference:
+nn/params/*.java) so checkpoints and tests speak the same vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import LayerConfig
+
+Params = dict[str, jax.Array]
+
+# canonical param keys (≙ DefaultParamInitializer / PretrainParamInitializer /
+# LSTMParamInitializer / ConvolutionParamInitializer)
+WEIGHT_KEY = "W"
+BIAS_KEY = "b"
+VISIBLE_BIAS_KEY = "vb"
+RECURRENT_WEIGHTS = "recurrentweights"
+DECODER_WEIGHTS = "decoderweights"
+DECODER_BIAS = "decoderbias"
+CONV_WEIGHTS = "convweights"
+CONV_BIAS = "convbias"
+
+
+class LayerModule(Protocol):
+    def init(self, key: jax.Array, conf: LayerConfig) -> Params: ...
+
+    def activate(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array: ...
+
+
+_REGISTRY: dict[str, Any] = {}
+
+
+def register(name: str) -> Callable[[Any], Any]:
+    def deco(mod: Any) -> Any:
+        _REGISTRY[name] = mod() if isinstance(mod, type) else mod
+        return mod
+
+    return deco
+
+
+def get(name: str) -> Any:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"Unknown layer type {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def dropout_mask(key: jax.Array, shape, rate: float, dtype) -> jax.Array:
+    """Inverted-dropout mask.
+
+    The reference multiplies activations by a Bernoulli(1-p) sample
+    (BaseLayer.applyDropOutIfNecessary:231, LSTM.activate uses the
+    scaled 1/(1-p) variant).  The scaled variant is used uniformly here
+    so eval-time activations need no rescaling.
+    """
+    keep = 1.0 - rate
+    return jax.random.bernoulli(key, keep, tuple(shape)).astype(dtype) / keep
+
+
+def apply_dropout(
+    x: jax.Array, conf: LayerConfig, key: jax.Array | None, training: bool
+) -> jax.Array:
+    if not training or conf.dropout <= 0.0 or key is None:
+        return x
+    return x * dropout_mask(key, x.shape, conf.dropout, x.dtype)
+
+
+def default_gradient(mod, params: Params, conf: LayerConfig, x: jax.Array, key: jax.Array):
+    """(score, grads) via autodiff of the module's score fn."""
+    return jax.value_and_grad(lambda p: mod.score(p, conf, x, key))(params)
+
+
+def l2_penalty(params: Params, conf: LayerConfig) -> jax.Array:
+    if not conf.use_regularization or conf.l2 <= 0.0:
+        return jnp.asarray(0.0)
+    w = params.get(WEIGHT_KEY)
+    if w is None:
+        return jnp.asarray(0.0)
+    return 0.5 * conf.l2 * jnp.sum(w * w)

@@ -1,0 +1,83 @@
+"""Denoising autoencoder (tied weights).
+
+≙ reference models/featuredetectors/autoencoder/AutoEncoder.java:22 —
+``encode`` (AutoEncoder.java:55), ``decode`` via the transposed weight
+matrix (AutoEncoder.java:72), binomial input corruption at
+``corruption_level``, and a reconstruction-cross-entropy objective
+(the hand-derived gradient of AutoEncoder.getGradient:97 is replaced by
+autodiff of the score).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import activations, losses, weights
+from deeplearning4j_tpu.nn.conf import LayerConfig
+from deeplearning4j_tpu.nn.layers import api
+from deeplearning4j_tpu.nn.layers.api import (
+    BIAS_KEY,
+    VISIBLE_BIAS_KEY,
+    WEIGHT_KEY,
+    Params,
+)
+
+
+@api.register("autoencoder")
+class AutoEncoder:
+    def init(self, key: jax.Array, conf: LayerConfig) -> Params:
+        kw, _ = jax.random.split(key)
+        dtype = dtypes.get_policy().param_dtype
+        return {
+            WEIGHT_KEY: weights.init_weights(
+                kw, (conf.n_in, conf.n_out), conf.weight_init, conf.dist
+            ),
+            BIAS_KEY: jnp.zeros((conf.n_out,), dtype),
+            VISIBLE_BIAS_KEY: jnp.zeros((conf.n_in,), dtype),
+        }
+
+    def encode(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        act = activations.get(conf.activation)
+        return act(x @ params[WEIGHT_KEY] + params[BIAS_KEY])
+
+    def decode(self, params: Params, conf: LayerConfig, h: jax.Array) -> jax.Array:
+        act = activations.get(conf.activation)
+        return act(h @ params[WEIGHT_KEY].T + params[VISIBLE_BIAS_KEY])
+
+    def corrupt(self, key: jax.Array, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        """Binomial masking noise at corruption_level (denoising AE)."""
+        if conf.corruption_level <= 0.0:
+            return x
+        keep = jax.random.bernoulli(key, 1.0 - conf.corruption_level, x.shape)
+        return x * keep.astype(x.dtype)
+
+    def reconstruct(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        return self.decode(params, conf, self.encode(params, conf, x))
+
+    def score(self, params: Params, conf: LayerConfig, x: jax.Array, key: jax.Array):
+        corrupted = self.corrupt(key, conf, x)
+        recon = self.reconstruct(params, conf, corrupted)
+        if conf.activation in ("sigmoid", "softmax"):
+            loss = losses.get("RECONSTRUCTION_CROSSENTROPY")(x, recon)
+        else:
+            loss = losses.get("MSE")(x, recon)
+        return loss + api.l2_penalty(params, conf)
+
+    def gradient(self, params: Params, conf: LayerConfig, x: jax.Array, key: jax.Array):
+        return api.default_gradient(self, params, conf, x, key)
+
+    def activate(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        x = api.apply_dropout(x, conf, key, training)
+        return self.encode(params, conf, x)
+
+    def pre_output(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        return x @ params[WEIGHT_KEY] + params[BIAS_KEY]

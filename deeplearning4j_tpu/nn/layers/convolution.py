@@ -1,0 +1,95 @@
+"""Convolution + downsample (max-pool) layer.
+
+≙ reference nn/layers/convolution/ConvolutionDownSampleLayer.java:22 —
+fused conv2d(VALID) + bias + activation + max-pool.  The reference's
+version is *forward-only* (getGradient returns null :113, fit is a no-op
+:117-121, conv training unfinished in that era); here the layer is fully
+trainable for free because the forward is a pure function under autodiff.
+
+TPU re-design: ``lax.conv_general_dilated`` in NHWC layout (the
+channels-last layout XLA tiles best onto the MXU: a KxK conv becomes an
+implicit matmul over [K*K*Cin, Cout]) and ``lax.reduce_window`` for the
+pool, replacing ND4J's im2col native kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import activations, weights
+from deeplearning4j_tpu.nn.conf import LayerConfig
+from deeplearning4j_tpu.nn.layers import api
+from deeplearning4j_tpu.nn.layers.api import CONV_BIAS, CONV_WEIGHTS, Params
+
+
+@api.register("conv_downsample")
+class ConvolutionDownSampleLayer:
+    """Expects NHWC input ``(batch, height, width, channels)``.
+
+    config fields used: ``filter_size`` (kh, kw), ``num_feature_maps``
+    (output channels), ``stride`` (pool window = pool stride, matching the
+    reference's "aka pool size" comment on stride,
+    NeuralNetConfiguration.java:95-97), ``n_in`` (input channels).
+    """
+
+    def init(self, key: jax.Array, conf: LayerConfig) -> Params:
+        kh, kw = conf.filter_size
+        c_in = max(conf.n_in, 1)
+        c_out = conf.num_feature_maps
+        kw_key, _ = jax.random.split(key)
+        fan_in = kh * kw * c_in
+        fan_out = kh * kw * c_out
+        w = weights.init_weights(kw_key, (fan_in, fan_out), conf.weight_init)
+        w = w[:, :c_out].reshape(kh, kw, c_in, c_out)
+        return {
+            CONV_WEIGHTS: w,
+            CONV_BIAS: jnp.zeros((c_out,), dtypes.get_policy().param_dtype),
+        }
+
+    def conv(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        policy = dtypes.get_policy()
+        w = policy.cast_to_compute(params[CONV_WEIGHTS])
+        x = policy.cast_to_compute(x)
+        out = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return out + params[CONV_BIAS].astype(out.dtype)
+
+    def pool(self, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        ph, pw = conf.stride
+        return lax.reduce_window(
+            x,
+            -jnp.inf,
+            lax.max,
+            window_dimensions=(1, ph, pw, 1),
+            window_strides=(1, ph, pw, 1),
+            padding="VALID",
+        )
+
+    def activate(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        x = api.apply_dropout(x, conf, key, training)
+        h = activations.get(conf.activation)(self.conv(params, conf, x))
+        return self.pool(conf, h)
+
+    def pre_output(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        return self.conv(params, conf, x)
+
+    def output_shape(self, conf: LayerConfig, input_shape) -> tuple[int, ...]:
+        n, h, w, _ = input_shape
+        kh, kw = conf.filter_size
+        ph, pw = conf.stride
+        return (n, (h - kh + 1) // ph, (w - kw + 1) // pw, conf.num_feature_maps)

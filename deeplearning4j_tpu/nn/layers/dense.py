@@ -1,0 +1,55 @@
+"""Dense (fully-connected) layer.
+
+≙ the reference's ``BaseLayer`` feed-forward behavior
+(reference: nn/layers/BaseLayer.java:159-198): ``pre_output = x·W + b``
+then elementwise activation, with optional dropout.  ``merge`` (parameter
+averaging across replicas, BaseLayer.java:253) is a pytree mean and lives
+in :mod:`deeplearning4j_tpu.parallel`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import activations, weights
+from deeplearning4j_tpu.nn.conf import LayerConfig
+from deeplearning4j_tpu.nn.layers import api
+from deeplearning4j_tpu.nn.layers.api import BIAS_KEY, WEIGHT_KEY, Params
+
+
+@api.register("dense")
+class DenseLayer:
+    def init(self, key: jax.Array, conf: LayerConfig) -> Params:
+        kw, _ = jax.random.split(key)
+        return {
+            WEIGHT_KEY: weights.init_weights(
+                kw, (conf.n_in, conf.n_out), conf.weight_init, conf.dist
+            ),
+            BIAS_KEY: jnp.zeros((conf.n_out,), dtypes.get_policy().param_dtype),
+        }
+
+    def pre_output(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        policy = dtypes.get_policy()
+        w = policy.cast_to_compute(params[WEIGHT_KEY])
+        out = policy.cast_to_compute(x) @ w + params[BIAS_KEY].astype(policy.compute_dtype)
+        return out
+
+    def activate(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        x = api.apply_dropout(x, conf, key, training)
+        return activations.get(conf.activation)(self.pre_output(params, conf, x))
+
+    def transpose(self, params: Params) -> Params:
+        """Flip a layer for decode paths (≙ BaseLayer.transpose:348)."""
+        return {
+            WEIGHT_KEY: params[WEIGHT_KEY].T,
+            BIAS_KEY: jnp.zeros((params[WEIGHT_KEY].shape[0],), params[BIAS_KEY].dtype),
+        }

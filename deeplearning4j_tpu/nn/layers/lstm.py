@@ -1,0 +1,187 @@
+"""LSTM (Karpathy char-RNN style) with ``lax.scan`` time recurrence.
+
+≙ reference models/classifiers/lstm/LSTM.java:36-514:
+- input at each step is the concat ``[1, x_t, h_{t-1}]`` against one fused
+  ``recurrentweights`` matrix of shape ``(1 + n_in + hidden, 4*hidden)``
+  (LSTMParamInitializer.java:30-33; note the reference sets
+  ``hidden == n_in``, the char-RNN convention — kept here);
+- gate order ``i, f, o`` (sigmoid) then ``g`` (tanh) (LSTM.activate:184-189);
+- ``c_t = i*g + f*c_{t-1}``, ``h_t = o * tanh(c_t)`` (or ``o*c_t`` for
+  non-tanh activation configs, LSTM.activate:192-203);
+- decoder projection ``y = h @ decoderweights + decoderbias``;
+- beam-search decoding (LSTM.BeamSearch:241-336).
+
+TPU re-design: the reference walks timesteps in a Java loop of BLAS calls
+and hand-writes BPTT (LSTM.backward:66-142).  Here the time loop is a
+``lax.scan`` (one compiled kernel, unrolled and pipelined by XLA), inputs
+are batched ``(B, T, F)``, and BPTT is autodiff through the scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import losses, weights
+from deeplearning4j_tpu.nn.conf import LayerConfig
+from deeplearning4j_tpu.nn.layers import api
+from deeplearning4j_tpu.nn.layers.api import (
+    DECODER_BIAS,
+    DECODER_WEIGHTS,
+    RECURRENT_WEIGHTS,
+    Params,
+)
+
+
+@api.register("lstm")
+class LSTMLayer:
+    """conf.n_in = input feature size (== hidden size, the reference's
+    char-RNN convention); conf.n_out = decoder output size (vocab)."""
+
+    def hidden_size(self, conf: LayerConfig) -> int:
+        return conf.n_in
+
+    def init(self, key: jax.Array, conf: LayerConfig) -> Params:
+        d = self.hidden_size(conf)
+        k1, k2 = jax.random.split(key)
+        dtype = dtypes.get_policy().param_dtype
+        return {
+            RECURRENT_WEIGHTS: weights.init_weights(
+                k1, (1 + conf.n_in + d, 4 * d), conf.weight_init, conf.dist
+            ),
+            DECODER_WEIGHTS: weights.init_weights(
+                k2, (d, conf.n_out), conf.weight_init, conf.dist
+            ),
+            DECODER_BIAS: jnp.zeros((conf.n_out,), dtype),
+        }
+
+    # -- core recurrence ---------------------------------------------------
+    def _gates(self, conf: LayerConfig, wr: jax.Array, x_t, h_prev):
+        """Fused gate computation for one step; x_t/h_prev are (B, F)."""
+        d = self.hidden_size(conf)
+        ones = jnp.ones(x_t.shape[:-1] + (1,), x_t.dtype)
+        h_in = jnp.concatenate([ones, x_t, h_prev], axis=-1)
+        ifog = h_in @ wr
+        i = jax.nn.sigmoid(ifog[..., :d])
+        f = jax.nn.sigmoid(ifog[..., d : 2 * d])
+        o = jax.nn.sigmoid(ifog[..., 2 * d : 3 * d])
+        g = jnp.tanh(ifog[..., 3 * d :])
+        return i, f, o, g
+
+    def _hout(self, conf: LayerConfig, o, c):
+        if conf.activation == "tanh":
+            return o * jnp.tanh(c)
+        return o * c
+
+    def scan_hidden(
+        self, params: Params, conf: LayerConfig, x: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """Run the recurrence over (B, T, F) input -> (hs, cs) each (B, T, d)."""
+        policy = dtypes.get_policy()
+        wr = policy.cast_to_compute(params[RECURRENT_WEIGHTS])
+        x = policy.cast_to_compute(x)
+        b = x.shape[0]
+        d = self.hidden_size(conf)
+        h0 = jnp.zeros((b, d), x.dtype)
+        c0 = jnp.zeros((b, d), x.dtype)
+
+        def step(carry, x_t):
+            h_prev, c_prev = carry
+            i, f, o, g = self._gates(conf, wr, x_t, h_prev)
+            c = i * g + f * c_prev
+            h = self._hout(conf, o, c)
+            return (h, c), (h, c)
+
+        # scan over time: move T to the leading axis
+        (_, _), (hs, cs) = lax.scan(step, (h0, c0), jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+    def decode(self, params: Params, conf: LayerConfig, h: jax.Array) -> jax.Array:
+        policy = dtypes.get_policy()
+        wd = policy.cast_to_compute(params[DECODER_WEIGHTS])
+        return h @ wd + params[DECODER_BIAS].astype(wd.dtype)
+
+    def activate(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        """(B, T, n_in) -> (B, T, n_out) decoder logits.
+
+        The reference drops the first timestep's output (its x is the
+        seed row xi; LSTM.activate:226 takes hOut[1:]); batched static
+        shapes keep all T outputs and let the caller align targets.
+        """
+        x = api.apply_dropout(x, conf, key, training)
+        hs, _ = self.scan_hidden(params, conf, x)
+        hs = api.apply_dropout(hs, conf, key, training)
+        return self.decode(params, conf, hs)
+
+    def supervised_score(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        labels: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        """Next-step cross-entropy over the sequence (one-hot labels (B,T,V))."""
+        logits = self.activate(params, conf, x, key, training)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1)) + api.l2_penalty(params, conf)
+
+    # -- single-step tick + decoding (≙ LSTM.lstmTick) ---------------------
+    def tick(self, params: Params, conf: LayerConfig, x_t, h, c):
+        """One decode step: (y_logits, h', c'); x_t/h/c are (F,)/(d,)."""
+        wr = params[RECURRENT_WEIGHTS]
+        i, f, o, g = self._gates(conf, wr, x_t[None, :], h[None, :])
+        c2 = (i * g + f * c[None, :])[0]
+        h2 = self._hout(conf, o[0], c2)
+        y = self.decode(params, conf, h2[None, :])[0]
+        return y, h2, c2
+
+    def beam_search(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        seed: jax.Array,
+        embeddings: jax.Array,
+        beam_size: int = 5,
+        n_steps: int = 20,
+    ) -> list[tuple[list[int], float]]:
+        """Beam-search decode (≙ LSTM.BeamSearch.search:257-320).
+
+        ``seed`` is the first input row; ``embeddings[i]`` is the input
+        row fed when token i was emitted (the reference's ``ws``).
+        Runs host-side over a jitted tick; index 0 is the stop token.
+        """
+        d = self.hidden_size(conf)
+        tick = jax.jit(lambda x_t, h, c: self.tick(params, conf, x_t, h, c))
+        y, h, c = tick(seed, jnp.zeros((d,)), jnp.zeros((d,)))
+        del y
+        beams: list[tuple[float, list[int], jax.Array, jax.Array]] = [(0.0, [], h, c)]
+        for _ in range(n_steps):
+            candidates: list[tuple[float, list[int], jax.Array, jax.Array]] = []
+            for logp, idxs, h, c in beams:
+                prev = idxs[-1] if idxs else 0
+                if idxs and prev == 0:  # finished beam
+                    candidates.append((logp, idxs, h, c))
+                    continue
+                y, h2, c2 = tick(embeddings[prev], h, c)
+                logp_tok = jax.nn.log_softmax(y)
+                top = jnp.argsort(-logp_tok)[:beam_size]
+                for t in top.tolist():
+                    candidates.append(
+                        (logp + float(logp_tok[t]), idxs + [t], h2, c2)
+                    )
+            candidates.sort(key=lambda b: -b[0])
+            beams = candidates[:beam_size]
+            if all(b[1] and b[1][-1] == 0 for b in beams):
+                break
+        return [(idxs, logp) for logp, idxs, _, _ in beams]

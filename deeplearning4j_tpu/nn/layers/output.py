@@ -1,0 +1,70 @@
+"""Output / classifier layer.
+
+≙ the reference's ``OutputLayer`` (reference: nn/layers/OutputLayer.java:35):
+a dense layer whose activation is typically softmax/sigmoid, scored by one
+of the loss menu's functions.  The reference hand-derives a weight gradient
+per loss case (OutputLayer.getWeightGradient:106-141); here the score is a
+pure function of params so ``jax.value_and_grad`` covers every case, and
+the softmax/MCXENT and sigmoid/XENT pairs run in the numerically-stable
+fused-logits form.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations, losses
+from deeplearning4j_tpu.nn.conf import LayerConfig
+from deeplearning4j_tpu.nn.layers import api
+from deeplearning4j_tpu.nn.layers.api import Params
+from deeplearning4j_tpu.nn.layers.dense import DenseLayer
+
+_FUSED = {
+    ("softmax", "MCXENT"),
+    ("softmax", "NEGATIVELOGLIKELIHOOD"),
+    ("sigmoid", "XENT"),
+    ("sigmoid", "RECONSTRUCTION_CROSSENTROPY"),
+}
+
+
+@api.register("output")
+class OutputLayer(DenseLayer):
+    def output(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        """Probabilities/activations for input x (≙ OutputLayer.output)."""
+        return activations.get(conf.activation)(self.pre_output(params, conf, x))
+
+    def supervised_score(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        labels: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        """Mean loss + L2 (≙ OutputLayer.score:60 via LossFunctions.score)."""
+        x = api.apply_dropout(x, conf, key, training)
+        logits = self.pre_output(params, conf, x)
+        pair = (conf.activation, conf.loss.upper())
+        if pair in _FUSED:
+            loss = losses.logits_loss(conf.loss, labels, logits)
+        else:
+            loss = losses.get(conf.loss)(labels, activations.get(conf.activation)(logits))
+        return loss + api.l2_penalty(params, conf)
+
+    def supervised_gradient(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        labels: jax.Array,
+        key: jax.Array | None = None,
+    ):
+        return jax.value_and_grad(
+            lambda p: self.supervised_score(p, conf, x, labels, key, training=True)
+        )(params)
+
+    def predict(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        """Argmax class prediction (≙ Classifier.predict)."""
+        return jnp.argmax(self.output(params, conf, x), axis=-1)

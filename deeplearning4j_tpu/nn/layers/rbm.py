@@ -1,0 +1,211 @@
+"""Restricted Boltzmann machine with CD-k pretraining.
+
+≙ reference models/featuredetectors/rbm/RBM.java:57-487 — the 4x4
+visible-{binary,gaussian,softmax,linear} × hidden-{binary,gaussian,
+softmax,rectified} unit-type matrix, propUp/propDown conditionals
+(RBM.java:345-438), NReLU sampling for rectified hidden units
+(RBM.java:235-251), and the CD-k Gibbs chain of getGradient
+(RBM.java:105-190).
+
+TPU re-design:
+- Unit-type dispatch happens at *trace time* (conf strings are static), so
+  each configuration compiles to straight-line XLA with no branching.
+- The k-step Gibbs chain is a ``lax.scan`` with threaded PRNG keys — the
+  whole CD-k gradient is one fused XLA computation (the reference runs k
+  Java-loop iterations of BLAS calls).
+- CD statistics are not the gradient of any scalar, so ``gradient`` is
+  explicit rather than autodiff (the one place the reference's
+  hand-gradient survives, as SURVEY §7 prescribes).  Sign convention:
+  returns a *descent* direction for the generic update rule
+  ``param -= lr * grad``; the weight statistic is averaged over the batch
+  (the reference sums W but averages biases — RBM.java:160-186 — an
+  inconsistency not reproduced).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import losses, weights
+from deeplearning4j_tpu.nn.conf import HiddenUnit, LayerConfig, VisibleUnit
+from deeplearning4j_tpu.nn.layers import api
+from deeplearning4j_tpu.nn.layers.api import (
+    BIAS_KEY,
+    VISIBLE_BIAS_KEY,
+    WEIGHT_KEY,
+    Params,
+)
+
+
+@api.register("rbm")
+class RBM:
+    # -- params ------------------------------------------------------------
+    def init(self, key: jax.Array, conf: LayerConfig) -> Params:
+        kw, _ = jax.random.split(key)
+        dtype = dtypes.get_policy().param_dtype
+        return {
+            WEIGHT_KEY: weights.init_weights(
+                kw, (conf.n_in, conf.n_out), conf.weight_init, conf.dist
+            ),
+            BIAS_KEY: jnp.zeros((conf.n_out,), dtype),
+            VISIBLE_BIAS_KEY: jnp.zeros((conf.n_in,), dtype),
+        }
+
+    # -- conditionals ------------------------------------------------------
+    def prop_up(self, params: Params, conf: LayerConfig, v: jax.Array) -> jax.Array:
+        """Hidden means given visible (≙ RBM.propUp:345)."""
+        pre = v @ params[WEIGHT_KEY] + params[BIAS_KEY]
+        h = conf.hidden_unit
+        if h == HiddenUnit.RECTIFIED:
+            return jax.nn.relu(pre)
+        if h == HiddenUnit.BINARY:
+            return jax.nn.sigmoid(pre)
+        if h == HiddenUnit.GAUSSIAN:
+            return pre
+        if h == HiddenUnit.SOFTMAX:
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(f"Unknown hidden unit {h!r}")
+
+    def prop_down(self, params: Params, conf: LayerConfig, h: jax.Array) -> jax.Array:
+        """Visible means given hidden (≙ RBM.propDown:393)."""
+        pre = h @ params[WEIGHT_KEY].T + params[VISIBLE_BIAS_KEY]
+        v = conf.visible_unit
+        if v == VisibleUnit.BINARY:
+            return jax.nn.sigmoid(pre)
+        if v in (VisibleUnit.GAUSSIAN, VisibleUnit.LINEAR):
+            return pre
+        if v == VisibleUnit.SOFTMAX:
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(f"Unknown visible unit {v!r}")
+
+    def sample_h_given_v(
+        self, key: jax.Array, params: Params, conf: LayerConfig, v: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """(means, samples) (≙ RBM.sampleHiddenGivenVisible:234)."""
+        mean = self.prop_up(params, conf, v)
+        h = conf.hidden_unit
+        if h == HiddenUnit.RECTIFIED:
+            # NReLU (Nair & Hinton): max(0, mu + N(0,1)*sqrt(sigmoid(mu)))
+            noise = jax.random.normal(key, mean.shape, mean.dtype)
+            sample = jax.nn.relu(mean + noise * jnp.sqrt(jax.nn.sigmoid(mean)))
+        elif h == HiddenUnit.BINARY:
+            sample = jax.random.bernoulli(key, mean).astype(mean.dtype)
+        elif h == HiddenUnit.GAUSSIAN:
+            sigma = jnp.std(mean, axis=-1, keepdims=True) + 1e-6
+            sample = mean + sigma * jax.random.normal(key, mean.shape, mean.dtype)
+        elif h == HiddenUnit.SOFTMAX:
+            sample = mean
+        else:
+            raise ValueError(f"Unknown hidden unit {h!r}")
+        return mean, sample
+
+    def sample_v_given_h(
+        self, key: jax.Array, params: Params, conf: LayerConfig, h: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """(means, samples) (≙ RBM.sampleVisibleGivenHidden:311)."""
+        mean = self.prop_down(params, conf, h)
+        v = conf.visible_unit
+        if v == VisibleUnit.BINARY:
+            sample = jax.random.bernoulli(key, mean).astype(mean.dtype)
+        elif v in (VisibleUnit.GAUSSIAN, VisibleUnit.LINEAR):
+            sample = mean + jax.random.normal(key, mean.shape, mean.dtype)
+        elif v == VisibleUnit.SOFTMAX:
+            sample = mean
+        else:
+            raise ValueError(f"Unknown visible unit {v!r}")
+        return mean, sample
+
+    # -- CD-k --------------------------------------------------------------
+    def gibbs_hvh(
+        self, key: jax.Array, params: Params, conf: LayerConfig, h: jax.Array
+    ):
+        """One h -> v -> h step (≙ RBM.gibbhVh:293)."""
+        kv, kh = jax.random.split(key)
+        v_mean, v_sample = self.sample_v_given_h(kv, params, conf, h)
+        h_mean, h_sample = self.sample_h_given_v(kh, params, conf, v_sample)
+        return (v_mean, v_sample, h_mean, h_sample)
+
+    def gradient(self, params: Params, conf: LayerConfig, x: jax.Array, key: jax.Array):
+        """(score, grads) from k steps of contrastive divergence.
+
+        ≙ RBM.getGradient (RBM.java:105-190): positive phase statistics
+        from the data, negative phase from the end of a k-step Gibbs
+        chain; sparsity-aware hidden-bias gradient when configured.
+        """
+        k_pos, k_chain = jax.random.split(key)
+        pos_h_mean, pos_h_sample = self.sample_h_given_v(k_pos, params, conf, x)
+
+        def step(h_sample, step_key):
+            v_mean, v_sample, h_mean, h_sample = self.gibbs_hvh(
+                step_key, params, conf, h_sample
+            )
+            return h_sample, (v_mean, v_sample, h_mean)
+
+        keys = jax.random.split(k_chain, conf.k)
+        _, (v_means, v_samples, h_means) = lax.scan(step, pos_h_sample, keys)
+        nv_mean, nv_sample, nh_mean = v_means[-1], v_samples[-1], h_means[-1]
+
+        n = x.shape[0]
+        w_stat = (x.T @ pos_h_mean - nv_sample.T @ nh_mean) / n
+        if conf.sparsity != 0.0:
+            # all hidden units pulled toward the sparsity target
+            # (≙ RBM.java:171-173: (sparsity - p_h).mean(0))
+            hb_stat = jnp.mean(conf.sparsity - pos_h_mean, axis=0)
+        else:
+            hb_stat = jnp.mean(pos_h_mean - nh_mean, axis=0)
+        vb_stat = jnp.mean(x - nv_sample, axis=0)
+
+        # likelihood-ascent statistics -> descent-direction gradient
+        grads = {
+            WEIGHT_KEY: -w_stat + (conf.l2 * params[WEIGHT_KEY] if conf.use_regularization else 0.0),
+            BIAS_KEY: -hb_stat,
+            VISIBLE_BIAS_KEY: -vb_stat,
+        }
+        score = self.score_from_reconstruction(params, conf, x, nv_mean)
+        return score, grads
+
+    # -- scoring / activations --------------------------------------------
+    def free_energy(self, params: Params, conf: LayerConfig, v: jax.Array) -> jax.Array:
+        """≙ RBM.freeEnergy:216 (sum over the batch)."""
+        wx_b = v @ params[WEIGHT_KEY] + params[BIAS_KEY]
+        v_bias_term = jnp.sum(v * params[VISIBLE_BIAS_KEY])
+        h_term = jnp.sum(jax.nn.softplus(wx_b))
+        return -h_term - v_bias_term
+
+    def reconstruct(self, params: Params, conf: LayerConfig, v: jax.Array) -> jax.Array:
+        """propDown(propUp(v)) (≙ RBM.transform:433)."""
+        return self.prop_down(params, conf, self.prop_up(params, conf, v))
+
+    def score_from_reconstruction(self, params, conf, x, recon) -> jax.Array:
+        if conf.visible_unit in (VisibleUnit.GAUSSIAN, VisibleUnit.LINEAR):
+            return losses.get("MSE")(x, recon)
+        return losses.get("RECONSTRUCTION_CROSSENTROPY")(x, recon)
+
+    def score(self, params: Params, conf: LayerConfig, x: jax.Array, key: jax.Array):
+        """Reconstruction score (≙ BasePretrainNetwork score semantics)."""
+        return self.score_from_reconstruction(
+            params, conf, x, self.reconstruct(params, conf, x)
+        ) + api.l2_penalty(params, conf)
+
+    def activate(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        """Hidden means — the forward pass used when stacked in a DBN.
+
+        The reference's sampleHiddenGivenVisible-then-mean convention for
+        feed-forward (MultiLayerNetwork.activationFromPrevLayer) reduces
+        to the hidden means.
+        """
+        x = api.apply_dropout(x, conf, key, training)
+        return self.prop_up(params, conf, x)
+
+    def pre_output(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        return x @ params[WEIGHT_KEY] + params[BIAS_KEY]
