@@ -1,0 +1,8 @@
+"""Optimization subsystem: updaters, line search, convex solvers, listeners.
+
+≙ reference ``org.deeplearning4j.optimize`` (Solver facade +
+GradientAscent / IterationGradientDescent / ConjugateGradient / LBFGS /
+StochasticHessianFree solvers + BackTrackLineSearch + GradientAdjustment).
+"""
+
+from deeplearning4j_tpu.optimize.solver import Solver  # noqa: F401

@@ -1,0 +1,99 @@
+"""Optimizer API: model-function bundle, termination conditions, listeners.
+
+≙ reference optimize/api/* (ConvexOptimizer, TerminationCondition,
+IterationListener, StepFunction).  A "model" for the solvers is a bundle
+of pure functions closed over the current minibatch — the functional
+replacement for the reference's stateful ``Model`` object that holds its
+input (nn/api/Model.java:16).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFunctions:
+    """Pure functions the solvers drive.
+
+    score_and_grad: (params, key) -> (score, grad_pytree) — lower is better
+    score:          (params, key) -> score
+    forward:        optional (params,) -> outputs, for Gauss-Newton products
+    loss_on_outputs: optional (outputs,) -> scalar, for Gauss-Newton products
+    """
+
+    score_and_grad: Callable[[Any, jax.Array], tuple[jax.Array, Any]]
+    score: Callable[[Any, jax.Array], jax.Array]
+    forward: Callable[[Any], Any] | None = None
+    loss_on_outputs: Callable[[Any], jax.Array] | None = None
+
+    @classmethod
+    def from_score(cls, score_fn, **kw) -> "ModelFunctions":
+        return cls(
+            score_and_grad=jax.value_and_grad(score_fn), score=score_fn, **kw
+        )
+
+
+# -- termination conditions (≙ optimize/terminations/*) -----------------------
+
+def eps_termination(eps: float = 1e-4, tolerance: float = 1e-10):
+    """≙ EpsTermination: relative score improvement below eps."""
+
+    def cond(score, old_score, grad_norm):
+        improvement = jnp.abs(old_score - score)
+        return improvement < eps * (jnp.abs(old_score) + jnp.abs(score) + tolerance)
+
+    return cond
+
+
+def norm2_termination(gradient_tolerance: float = 1e-8):
+    """≙ Norm2Termination: gradient 2-norm below tolerance."""
+
+    def cond(score, old_score, grad_norm):
+        return grad_norm < gradient_tolerance
+
+    return cond
+
+
+def default_terminations() -> list:
+    return [eps_termination(), norm2_termination()]
+
+
+# -- listeners (≙ optimize/api/IterationListener.java:13) ---------------------
+
+class IterationListener:
+    def iteration_done(self, info: dict) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Logs score every N iterations (≙ the reference's per-iteration
+    'Score at iteration i is s' logging, BaseOptimizer.java:160)."""
+
+    def __init__(self, print_every: int = 10):
+        self.print_every = print_every
+        self.history: list[float] = []
+
+    def iteration_done(self, info: dict) -> None:
+        self.history.append(float(info["score"]))
+        i = info["iteration"]
+        if i % self.print_every == 0:
+            log.info("Score at iteration %d is %s", i, info["score"])
+
+
+class ComposableIterationListener(IterationListener):
+    """≙ ComposableIterationListener: fan out to several listeners."""
+
+    def __init__(self, listeners: Sequence[IterationListener]):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, info: dict) -> None:
+        for listener in self.listeners:
+            listener.iteration_done(info)

@@ -1,0 +1,70 @@
+"""Backtracking line search.
+
+≙ reference ``BackTrackLineSearch`` (optimize/solvers/BackTrackLineSearch.java,
+the MALLET lnsrch port): walk back along a descent direction until the
+Armijo sufficient-decrease condition holds.
+
+TPU re-design: the whole search is one ``lax.while_loop`` inside jit —
+each trial step re-evaluates the jitted score, so a line-searched solver
+iteration compiles to a single XLA computation with no host round-trips
+(the reference re-scores the mutable model object per trial step from
+Java).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.utils import tree_math as tm
+
+
+class LineSearchResult(NamedTuple):
+    step: jax.Array  # chosen step size (0.0 if no acceptable step)
+    score: jax.Array  # score at the chosen step
+    n_evals: jax.Array
+
+
+def backtrack(
+    score_fn: Callable,
+    params,
+    direction,
+    grad,
+    initial_step: float | jax.Array = 1.0,
+    max_iterations: int = 5,
+    c1: float = 1e-4,
+    rho: float = 0.5,
+    min_step: float = 1e-12,
+) -> LineSearchResult:
+    """Find t with score(params + t*direction) <= score(params) + c1*t*<g,d>.
+
+    ``direction`` must be a descent direction (<grad, direction> < 0);
+    if it is not, the search degenerates to accepting the smallest trial.
+    """
+    phi0 = score_fn(params)
+    slope = tm.vdot(grad, direction)
+
+    def trial(t):
+        return score_fn(tm.axpy(t, direction, params))
+
+    def cond(state):
+        t, score, it = state
+        armijo = score <= phi0 + c1 * t * slope
+        return (~armijo) & (it < max_iterations) & (t > min_step)
+
+    def body(state):
+        t, _, it = state
+        t_new = t * rho
+        return (t_new, trial(t_new), it + 1)
+
+    t0 = jnp.asarray(initial_step, jnp.float32)
+    init = (t0, trial(t0), jnp.asarray(1, jnp.int32))
+    t, score, n = lax.while_loop(cond, body, init)
+    # if even the smallest step failed to decrease, report step=0
+    ok = score <= phi0 + c1 * t * slope
+    t = jnp.where(ok, t, 0.0)
+    score = jnp.where(ok, score, phi0)
+    return LineSearchResult(step=t, score=score, n_evals=n)

@@ -1,0 +1,64 @@
+"""Solver facade.
+
+≙ reference ``optimize/Solver.java:15-45``: select the optimizer from the
+config's OptimizationAlgorithm and run it.  Two execution modes:
+
+- no listeners: the whole iteration loop runs inside one jitted
+  ``lax.while_loop`` (fastest; zero host round-trips);
+- with listeners: a jitted single-iteration step driven by a Python loop,
+  invoking IterationListener hooks with the live score each iteration
+  (≙ BaseOptimizer.java:146-148) — the listener path necessarily syncs
+  device→host once per iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+
+from deeplearning4j_tpu.nn.conf import LayerConfig
+from deeplearning4j_tpu.optimize import solvers
+from deeplearning4j_tpu.optimize.api import IterationListener, ModelFunctions
+
+
+class Solver:
+    def __init__(
+        self,
+        conf: LayerConfig,
+        model: ModelFunctions,
+        listeners: Sequence[IterationListener] = (),
+        algo: str | None = None,
+    ):
+        self.conf = conf
+        self.model = model
+        self.listeners = list(listeners)
+        self.algo = algo or conf.optimization_algo
+        self._init_state, self._step = solvers.make_step(conf, model, self.algo)
+        self._jit_step = jax.jit(self._step)
+
+    def optimize(
+        self, params: Any, key: jax.Array, num_iterations: int | None = None
+    ) -> tuple[Any, float]:
+        """Run the solver; returns (new_params, final_score)."""
+        n = num_iterations or self.conf.num_iterations
+        if not self.listeners:
+            params, score, _ = solvers.optimize_jit(
+                self.conf, self.model, params, key, n, self.algo
+            )
+            return params, float(score)
+
+        state = self._init_state(params, key)
+        for i in range(n):
+            state = self._jit_step(state)
+            info = {
+                "iteration": i,
+                "score": float(state.score),
+                "old_score": float(state.old_score),
+                "step_size": float(state.step_size),
+            }
+            for listener in self.listeners:
+                listener.iteration_done(info)
+            if bool(state.done):
+                break
+        return state.params, float(state.score)

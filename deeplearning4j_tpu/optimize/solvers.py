@@ -1,0 +1,415 @@
+"""Convex solvers: line-searched gradient descent, plain iteration GD,
+Polak-Ribière conjugate gradient, L-BFGS, stochastic Hessian-free.
+
+≙ reference optimize/solvers/ — GradientAscent.java, IterationGradientDescent.java,
+ConjugateGradient.java (Polak-Ribière), LBFGS.java (two-loop recursion),
+StochasticHessianFree.java (CG on Gauss-Newton products with damping), all
+driven by the BaseOptimizer.optimize loop (BaseOptimizer.java:97-160).
+
+TPU re-design: each solver's full iteration loop — gradient adjustment,
+line search, parameter update, termination checks — is a single
+``lax.while_loop`` compiled once per (config, batch-shape).  The reference
+runs this loop in Java, re-entering BLAS per score evaluation.  The
+convention is *minimization* throughout (scores are losses); the
+reference's maximize/minimize flag and negative step functions collapse
+into the sign of the descent direction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf import LayerConfig, OptimizationAlgorithm
+from deeplearning4j_tpu.optimize import linesearch, updaters
+from deeplearning4j_tpu.optimize.api import ModelFunctions
+from deeplearning4j_tpu.utils import tree_math as tm
+
+
+class SolverState(NamedTuple):
+    params: Any
+    updater: updaters.UpdaterState
+    extra: Any  # algorithm-specific carry
+    score: jax.Array
+    old_score: jax.Array
+    step_size: jax.Array
+    key: jax.Array
+    iteration: jax.Array
+    done: jax.Array
+
+
+# -- per-algorithm direction rules -------------------------------------------
+
+def _gd_extra(params):
+    return ()
+
+
+def _gd_direction(conf, extra, adj_grad, raw_grad):
+    return tm.neg(adj_grad), ()
+
+
+def _cg_extra(params):
+    # (prev_raw_grad, prev_direction, have_prev)
+    return (tm.zeros_like(params), tm.zeros_like(params), jnp.asarray(False))
+
+
+def _cg_direction(conf, extra, adj_grad, raw_grad):
+    """Polak-Ribière conjugate direction (≙ ConjugateGradient.java)."""
+    prev_g, prev_d, have_prev = extra
+    g = adj_grad
+    denominator = tm.vdot(prev_g, prev_g)
+    beta_pr = tm.vdot(g, tm.sub(g, prev_g)) / jnp.maximum(denominator, 1e-20)
+    beta = jnp.where(have_prev, jnp.maximum(beta_pr, 0.0), 0.0)
+    d = tm.axpy(beta, prev_d, tm.neg(g))
+    # restart with steepest descent if d is not a descent direction
+    descent = tm.vdot(d, g) < 0
+    d = tm.where(descent, d, tm.neg(g))
+    return d, (g, d, jnp.asarray(True))
+
+
+def _lbfgs_extra_factory(m: int):
+    def make(params):
+        zeros = tm.zeros_like(params)
+        s_hist = jax.tree.map(lambda z: jnp.stack([z] * m), zeros)
+        y_hist = jax.tree.map(lambda z: jnp.stack([z] * m), zeros)
+        rho = jnp.zeros((m,))
+        return (
+            s_hist,
+            y_hist,
+            rho,
+            jnp.asarray(0, jnp.int32),  # count of stored pairs
+            tm.zeros_like(params),  # prev params
+            tm.zeros_like(params),  # prev raw grad
+            jnp.asarray(False),
+        )
+
+    return make
+
+
+def _lbfgs_direction_factory(m: int):
+    def direction(conf, extra, adj_grad, raw_grad):
+        """Two-loop recursion (≙ LBFGS.java)."""
+        s_hist, y_hist, rho, count, prev_p, prev_g, have_prev = extra
+        g = adj_grad
+
+        def hist_at(hist, i):
+            return jax.tree.map(lambda h: h[i], hist)
+
+        q = g
+        alphas = jnp.zeros((m,))
+        # newest pair is at index (count-1) % m; iterate newest -> oldest
+        def bw(i, carry):
+            q, alphas = carry
+            idx = (count - 1 - i) % m
+            valid = i < count
+            s_i, y_i = hist_at(s_hist, idx), hist_at(y_hist, idx)
+            alpha = rho[idx] * tm.vdot(s_i, q)
+            alpha = jnp.where(valid, alpha, 0.0)
+            q = tm.axpy(-alpha, y_i, q)
+            return q, alphas.at[idx].set(alpha)
+
+        q, alphas = lax.fori_loop(0, m, bw, (q, alphas))
+
+        # initial Hessian scaling gamma = <s,y>/<y,y> of newest pair
+        newest = (count - 1) % m
+        s_n, y_n = hist_at(s_hist, newest), hist_at(y_hist, newest)
+        gamma = tm.vdot(s_n, y_n) / jnp.maximum(tm.vdot(y_n, y_n), 1e-20)
+        gamma = jnp.where(count > 0, gamma, 1.0)
+        z = tm.scale(q, gamma)
+
+        def fw(i, z):
+            idx = (count - m + i) % m  # oldest -> newest among valid
+            valid = i >= (m - jnp.minimum(count, m))
+            s_i, y_i = hist_at(s_hist, idx), hist_at(y_hist, idx)
+            beta = rho[idx] * tm.vdot(y_i, z)
+            corr = tm.scale(s_i, alphas[idx] - beta)
+            z2 = tm.add(z, corr)
+            return tm.where(valid, z2, z)
+
+        z = lax.fori_loop(0, m, fw, z)
+        d = tm.neg(z)
+        descent = tm.vdot(d, g) < 0
+        d = tm.where(descent, d, tm.neg(g))
+        return d, extra
+
+    return direction
+
+
+def _lbfgs_post_factory(m: int):
+    def post(extra, new_params, new_raw_grad):
+        s_hist, y_hist, rho, count, prev_p, prev_g, have_prev = extra
+        s = tm.sub(new_params, prev_p)
+        y = tm.sub(new_raw_grad, prev_g)
+        sy = tm.vdot(s, y)
+        store = have_prev & (sy > 1e-10)
+        idx = count % m
+
+        def put(hist, v):
+            return jax.tree.map(
+                lambda h, vi: jnp.where(store, h.at[idx].set(vi), h), hist, v
+            )
+
+        s_hist = put(s_hist, s)
+        y_hist = put(y_hist, y)
+        rho = jnp.where(store, rho.at[idx].set(1.0 / jnp.maximum(sy, 1e-20)), rho)
+        count = jnp.where(store, count + 1, count)
+        return (s_hist, y_hist, rho, count, new_params, new_raw_grad, jnp.asarray(True))
+
+    return post
+
+
+_ALGOS = {
+    OptimizationAlgorithm.GRADIENT_DESCENT: (_gd_extra, _gd_direction, None, True),
+    OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT: (
+        _gd_extra,
+        _gd_direction,
+        None,
+        False,
+    ),
+    OptimizationAlgorithm.CONJUGATE_GRADIENT: (_cg_extra, _cg_direction, None, True),
+}
+_LBFGS_M = 10
+_ALGOS[OptimizationAlgorithm.LBFGS] = (
+    _lbfgs_extra_factory(_LBFGS_M),
+    _lbfgs_direction_factory(_LBFGS_M),
+    _lbfgs_post_factory(_LBFGS_M),
+    True,
+)
+
+
+def make_step(conf: LayerConfig, model: ModelFunctions, algo: str | None = None):
+    """Build (init_state, step) for one solver iteration, jit-compatible."""
+    algo = algo or conf.optimization_algo
+    if algo == OptimizationAlgorithm.HESSIAN_FREE:
+        return _make_hf_step(conf, model)
+    if algo not in _ALGOS:
+        raise ValueError(f"Unknown optimization algorithm {algo!r}")
+    make_extra, direction_fn, post_fn, use_line_search = _ALGOS[algo]
+
+    def init_state(params, key) -> SolverState:
+        k0, key = jax.random.split(key)
+        score = model.score(params, k0)
+        return SolverState(
+            params=params,
+            updater=updaters.init(params),
+            extra=make_extra(params),
+            score=score,
+            old_score=jnp.asarray(jnp.inf, jnp.float32),
+            step_size=jnp.asarray(1.0, jnp.float32),
+            key=key,
+            iteration=jnp.asarray(0, jnp.int32),
+            done=jnp.asarray(False),
+        )
+
+    def step(state: SolverState) -> SolverState:
+        key, k_grad, k_score = jax.random.split(state.key, 3)
+        score, raw_grad = model.score_and_grad(state.params, k_grad)
+        adj_grad, updater = updaters.adjust(conf, state.updater, raw_grad, state.params)
+        direction, extra = direction_fn(conf, state.extra, adj_grad, raw_grad)
+
+        if use_line_search:
+            result = linesearch.backtrack(
+                lambda p: model.score(p, k_score),
+                state.params,
+                direction,
+                raw_grad,
+                initial_step=1.0,
+                max_iterations=conf.num_line_search_iterations,
+            )
+            step_size = result.step
+            new_params = tm.axpy(step_size, direction, state.params)
+            new_score = result.score
+        else:
+            step_size = jnp.asarray(1.0, jnp.float32)
+            new_params = tm.add(state.params, direction)
+            new_score = model.score(new_params, k_score)
+
+        if post_fn is not None:
+            _, new_raw_grad = model.score_and_grad(new_params, k_grad)
+            extra = post_fn(extra, new_params, new_raw_grad)
+
+        grad_norm = tm.norm2(raw_grad)
+        improvement = jnp.abs(score - new_score)
+        eps_hit = improvement < 1e-6 * (jnp.abs(score) + jnp.abs(new_score) + 1e-10)
+        norm_hit = grad_norm < 1e-8
+        stalled = use_line_search and False  # step=0 handled via eps_hit
+        done = eps_hit | norm_hit | jnp.asarray(stalled)
+
+        return SolverState(
+            params=new_params,
+            updater=updater,
+            extra=extra,
+            score=new_score,
+            old_score=score,
+            step_size=step_size,
+            key=key,
+            iteration=state.iteration + 1,
+            done=done,
+        )
+
+    return init_state, step
+
+
+# -- Hessian-free ------------------------------------------------------------
+
+class HFExtra(NamedTuple):
+    damping: jax.Array
+    x0: Any  # CG warm start
+
+
+def _gvp_fn(model: ModelFunctions, params):
+    """Gauss-Newton vector product v -> J'H_L J v at `params`.
+
+    ≙ the reference's R-operator path (MultiLayerNetwork.computeDeltasR /
+    backPropGradient2, MultiLayerNetwork.java:496,935) — re-expressed as
+    jvp over the forward + loss Hessian + vjp, which is exactly the
+    Gauss-Newton product without any hand-written R-op.
+    Falls back to the full Hessian-vector product when the model does not
+    expose a forward/loss split.
+    """
+    if model.forward is not None and model.loss_on_outputs is not None:
+        z, jvp_to_z = jax.linearize(model.forward, params)
+        _, vjp_from_z = jax.vjp(model.forward, params)
+        loss_grad = jax.grad(model.loss_on_outputs)
+
+        def gvp(v):
+            z_dot = jvp_to_z(v)
+            hz = jax.jvp(loss_grad, (z,), (z_dot,))[1]
+            return vjp_from_z(hz)[0]
+
+        return gvp
+
+    # full HVP fallback: d/dp <grad(score), v>
+    def hvp(v):
+        key = jax.random.key(0)
+        return jax.jvp(lambda p: model.score_and_grad(p, key)[1], (params,), (v,))[1]
+
+    return hvp
+
+
+def _cg_solve(matvec, b, x0, max_iters: int = 50, tol: float = 1e-5):
+    """Conjugate-gradient solve of matvec(x)=b (≙ StochasticHessianFree.conjGradient:72)."""
+
+    def cond(state):
+        x, r, p, rs, it = state
+        return (rs > tol * tol) & (it < max_iters)
+
+    def body(state):
+        x, r, p, rs, it = state
+        ap = matvec(p)
+        alpha = rs / jnp.maximum(tm.vdot(p, ap), 1e-20)
+        x = tm.axpy(alpha, p, x)
+        r = tm.axpy(-alpha, ap, r)
+        rs_new = tm.vdot(r, r)
+        p = tm.axpy(rs_new / jnp.maximum(rs, 1e-20), p, r)
+        return (x, r, p, rs_new, it + 1)
+
+    r0 = tm.sub(b, matvec(x0))
+    state = (x0, r0, r0, tm.vdot(r0, r0), jnp.asarray(0, jnp.int32))
+    x, r, p, rs, it = lax.while_loop(cond, body, state)
+    return x
+
+
+def _make_hf_step(conf: LayerConfig, model: ModelFunctions):
+    """Stochastic Hessian-free (Martens): CG on damped Gauss-Newton products
+    with Levenberg-Marquardt damping adaptation and line-searched update.
+
+    ≙ StochasticHessianFree.java (optimize/solvers/, 245 LoC) including its
+    CG-with-damping core; the reference's hand-built R-op forward/backward
+    is replaced by jvp/vjp (see _gvp_fn).
+    """
+
+    def init_state(params, key) -> SolverState:
+        k0, key = jax.random.split(key)
+        return SolverState(
+            params=params,
+            updater=updaters.init(params),
+            extra=HFExtra(
+                damping=jnp.asarray(conf.__dict__.get("damping", 10.0), jnp.float32),
+                x0=tm.zeros_like(params),
+            ),
+            score=model.score(params, k0),
+            old_score=jnp.asarray(jnp.inf, jnp.float32),
+            step_size=jnp.asarray(1.0, jnp.float32),
+            key=key,
+            iteration=jnp.asarray(0, jnp.int32),
+            done=jnp.asarray(False),
+        )
+
+    def step(state: SolverState) -> SolverState:
+        key, k_grad, k_score = jax.random.split(state.key, 3)
+        score, grad = model.score_and_grad(state.params, k_grad)
+        lam = state.extra.damping
+        gvp = _gvp_fn(model, state.params)
+
+        def damped(v):
+            return tm.axpy(lam, v, gvp(v))
+
+        delta = _cg_solve(damped, tm.neg(grad), state.extra.x0)
+
+        # quadratic-model reduction for the LM ratio
+        q_red = -(tm.vdot(grad, delta) + 0.5 * tm.vdot(delta, damped(delta)))
+        result = linesearch.backtrack(
+            lambda p: model.score(p, k_score),
+            state.params,
+            delta,
+            grad,
+            initial_step=1.0,
+            max_iterations=conf.num_line_search_iterations,
+        )
+        new_params = tm.axpy(result.step, delta, state.params)
+        new_score = result.score
+
+        actual_red = score - new_score
+        rho = actual_red / jnp.maximum(q_red, 1e-20)
+        lam = jnp.where(rho > 0.75, lam * (2.0 / 3.0), lam)
+        lam = jnp.where(rho < 0.25, lam * 1.5, lam)
+
+        improvement = jnp.abs(score - new_score)
+        done = improvement < 1e-6 * (jnp.abs(score) + jnp.abs(new_score) + 1e-10)
+
+        return SolverState(
+            params=new_params,
+            updater=state.updater,
+            extra=HFExtra(damping=lam, x0=tm.scale(delta, 0.95)),
+            score=new_score,
+            old_score=score,
+            step_size=result.step,
+            key=key,
+            iteration=state.iteration + 1,
+            done=done,
+        )
+
+    return init_state, step
+
+
+def optimize_jit(
+    conf: LayerConfig,
+    model: ModelFunctions,
+    params,
+    key: jax.Array,
+    num_iterations: int | None = None,
+    algo: str | None = None,
+):
+    """Run the full solver loop inside one jitted while_loop.
+
+    Returns (params, final_score, iterations_run).
+    """
+    n = num_iterations or conf.num_iterations
+    init_state, step = make_step(conf, model, algo)
+
+    @jax.jit
+    def run(params, key):
+        state = init_state(params, key)
+
+        def cond(s):
+            return (~s.done) & (s.iteration < n)
+
+        state = lax.while_loop(cond, step, state)
+        return state.params, state.score, state.iteration
+
+    return run(params, key)

@@ -1,0 +1,1 @@
+"""Utility modules (tree algebra, math, serialization, sequence decoding)."""

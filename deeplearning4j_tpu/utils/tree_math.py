@@ -1,0 +1,73 @@
+"""Pytree linear algebra.
+
+The reference's CG/LBFGS/HF solvers operate on one packed parameter vector
+(``MultiLayerNetwork.params()``/``pack()``, reference:
+nn/multilayer/MultiLayerNetwork.java:762,808).  On TPU, packing would
+force large concat copies through HBM; instead the solvers do their
+vector algebra directly on parameter pytrees — XLA fuses the per-leaf
+elementwise work, and dot products reduce per-leaf then sum scalars.
+``ravel``/``unravel`` remain available for wire formats and checkpoints.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+
+Tree = object  # any pytree of arrays
+
+
+def vdot(a: Tree, b: Tree) -> jax.Array:
+    leaves_a = jax.tree.leaves(a)
+    leaves_b = jax.tree.leaves(b)
+    return sum(
+        (jnp.vdot(x, y) for x, y in zip(leaves_a, leaves_b)),
+        start=jnp.asarray(0.0),
+    )
+
+
+def add(a: Tree, b: Tree) -> Tree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def sub(a: Tree, b: Tree) -> Tree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def scale(a: Tree, s) -> Tree:
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def axpy(alpha, x: Tree, y: Tree) -> Tree:
+    """alpha*x + y."""
+    return jax.tree.map(lambda xi, yi: alpha * xi + yi, x, y)
+
+
+def neg(a: Tree) -> Tree:
+    return jax.tree.map(jnp.negative, a)
+
+
+def norm2(a: Tree) -> jax.Array:
+    return jnp.sqrt(vdot(a, a))
+
+
+def zeros_like(a: Tree) -> Tree:
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def ones_like(a: Tree) -> Tree:
+    return jax.tree.map(jnp.ones_like, a)
+
+
+def where(pred, a: Tree, b: Tree) -> Tree:
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def ravel(a: Tree) -> tuple[jax.Array, callable]:
+    """Pack to one vector (wire/checkpoint format; ≙ MultiLayerNetwork.pack)."""
+    return jax.flatten_util.ravel_pytree(a)
+
+
+def cast(a: Tree, dtype) -> Tree:
+    return jax.tree.map(lambda x: x.astype(dtype), a)
