@@ -1,0 +1,16 @@
+"""Data pipeline: DataSet container, fetchers, iterators, preprocessors.
+
+≙ reference ``org.deeplearning4j.datasets`` (~2400 LoC, SURVEY §2):
+fetcher/iterator split, MNIST/Iris/LFW/Curves/CSV sources, sampling and
+reconstruction iterators, record-reader bridge, preprocessor hook.
+"""
+
+from deeplearning4j_tpu.datasets.base import DataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    BaseDatasetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
+    SamplingDataSetIterator,
+    TestDataSetIterator,
+)

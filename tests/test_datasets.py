@@ -1,0 +1,137 @@
+"""Data-pipeline tests ≙ reference CSVDataSetIteratorTest / DataSetTest +
+fetcher behaviors."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    BaseDatasetIterator,
+    DataSet,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
+    SamplingDataSetIterator,
+    TestDataSetIterator,
+)
+from deeplearning4j_tpu.datasets import fetchers
+from deeplearning4j_tpu.datasets.base import to_one_hot
+from deeplearning4j_tpu.datasets.iterators import ShardedDataSetIterator, moving_window
+
+
+def test_dataset_basics():
+    ds = DataSet(np.arange(20, dtype=np.float32).reshape(10, 2), to_one_hot(np.arange(10) % 3, 3))
+    assert ds.num_examples() == 10
+    assert ds.num_inputs() == 2
+    assert ds.num_outcomes() == 3
+    train, test = ds.split_test_and_train(7)
+    assert train.num_examples() == 7 and test.num_examples() == 3
+    shuffled = ds.shuffle(0)
+    assert sorted(shuffled.features[:, 0].tolist()) == ds.features[:, 0].tolist()
+
+
+def test_one_hot():
+    oh = to_one_hot(np.array([0, 2, 1]), 3)
+    assert oh.shape == (3, 3)
+    assert (oh.argmax(1) == [0, 2, 1]).all()
+
+
+def test_iris_fetcher():
+    f = fetchers.IrisDataFetcher()
+    assert f.total_examples() == 150
+    assert f.input_columns() == 4
+    assert f.total_outcomes() == 3
+    batch = f.fetch(10)
+    assert batch.features.shape == (10, 4)
+    it = BaseDatasetIterator(30, None, f)
+    batches = list(it)
+    assert len(batches) == 5
+    assert all(b.num_examples() == 30 for b in batches)
+
+
+def test_mnist_synthetic_fallback_and_idx_reader(tmp_path):
+    ds = fetchers.mnist(train=True, n=256)
+    assert ds.features.shape == (256, 784)
+    assert ds.labels.shape == (256, 10)
+    assert 0 <= ds.features.min() and ds.features.max() <= 1
+
+    # synthetic classes must be separable by a trivial nearest-centroid rule
+    feats, labels = ds.features, ds.labels.argmax(1)
+    centroids = np.stack([feats[labels == c].mean(0) for c in range(10)])
+    pred = ((feats[:, None, :] - centroids[None]) ** 2).sum(-1).argmin(1)
+    assert (pred == labels).mean() > 0.9
+
+    # idx round-trip
+    import struct
+
+    imgs = (ds.features[:16].reshape(16, 28, 28) * 255).astype(np.uint8)
+    p = tmp_path / "imgs-idx3-ubyte"
+    with open(p, "wb") as fh:
+        fh.write(struct.pack(">HBB", 0, 0x08, 3))
+        fh.write(struct.pack(">III", 16, 28, 28))
+        fh.write(imgs.tobytes())
+    back = fetchers._read_idx(p)
+    assert back.shape == (16, 28, 28)
+    assert (back == imgs).all()
+
+
+def test_csv_fetcher(tmp_path):
+    p = tmp_path / "data.csv"
+    rows = ["1.0,2.0,0", "3.0,4.0,1", "5.0,6.0,2", "7.0,8.0,0"]
+    p.write_text("\n".join(rows))
+    ds = fetchers.csv(p, label_column=2)
+    assert ds.features.shape == (4, 2)
+    assert ds.labels.shape == (4, 3)
+
+
+def test_lfw_synthetic():
+    ds = fetchers.lfw(n=50)
+    assert ds.features.shape[0] == 50
+    assert ds.labels is not None
+
+
+def test_sampling_and_reconstruction_iterators():
+    ds = DataSet(np.random.default_rng(0).normal(size=(100, 5)).astype(np.float32),
+                 to_one_hot(np.zeros(100), 2))
+    s = SamplingDataSetIterator(ds, batch_size=8, total_batches=3)
+    batches = list(s)
+    assert len(batches) == 3 and batches[0].features.shape == (8, 5)
+
+    r = ReconstructionDataSetIterator(ListDataSetIterator(ds, 25))
+    for b in r:
+        assert (b.labels == b.features).all()
+    assert r.total_outcomes() == 5
+
+
+def test_multiple_epochs_and_test_iterator():
+    ds = DataSet(np.ones((10, 2), dtype=np.float32))
+    inner = TestDataSetIterator(ListDataSetIterator(ds, 5))
+    it = MultipleEpochsIterator(3, inner)
+    assert len(list(it)) == 6
+    assert inner.batches_served == 6
+    assert inner.resets == 3
+
+
+def test_sharded_iterator_partitions_batches():
+    ds = DataSet(np.arange(80, dtype=np.float32).reshape(40, 2))
+    shards = [
+        list(ShardedDataSetIterator(ListDataSetIterator(ds, 4), shard=s, num_shards=2))
+        for s in range(2)
+    ]
+    assert len(shards[0]) == 5 and len(shards[1]) == 5
+    seen = np.concatenate(
+        [b.features for b in shards[0]] + [b.features for b in shards[1]]
+    )
+    assert sorted(seen[:, 0].tolist()) == ds.features[:, 0].tolist()
+
+
+def test_moving_window():
+    m = np.arange(16).reshape(4, 4)
+    w = moving_window(m, 2, 2)
+    assert w.shape == (9, 2, 2)
+    assert (w[0] == [[0, 1], [4, 5]]).all()
+
+
+def test_curves():
+    ds = fetchers.curves(n=10, dim=100)
+    assert ds.features.shape == (10, 100)
+    assert ds.labels is None
