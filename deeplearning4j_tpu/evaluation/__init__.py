@@ -1,0 +1,3 @@
+"""Evaluation: confusion matrix + classification metrics."""
+
+from deeplearning4j_tpu.evaluation.evaluation import ConfusionMatrix, Evaluation  # noqa: F401

@@ -1,0 +1,101 @@
+"""Classification evaluation.
+
+≙ reference eval/Evaluation.java:13-530 + eval/ConfusionMatrix.java:
+multiclass confusion matrix, accuracy, per-class and micro-averaged
+precision/recall/F1, and the text ``stats()`` report.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """Counts[actual][predicted] (≙ eval/ConfusionMatrix.java)."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.counts = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1) -> None:
+        self.counts[actual, predicted] += count
+
+    def add_batch(self, actual: np.ndarray, predicted: np.ndarray) -> None:
+        np.add.at(self.counts, (actual, predicted), 1)
+
+    def actual_total(self, cls: int) -> int:
+        return int(self.counts[cls].sum())
+
+    def predicted_total(self, cls: int) -> int:
+        return int(self.counts[:, cls].sum())
+
+    def count(self, actual: int, predicted: int) -> int:
+        return int(self.counts[actual, predicted])
+
+    def total(self) -> int:
+        return int(self.counts.sum())
+
+
+class Evaluation:
+    """Accumulating evaluator (≙ Evaluation.eval:30, f1:203, stats:81)."""
+
+    def __init__(self, num_classes: int | None = None):
+        self.num_classes = num_classes
+        self.confusion: ConfusionMatrix | None = (
+            ConfusionMatrix(num_classes) if num_classes else None
+        )
+
+    def eval(self, labels, predictions) -> None:
+        """labels: one-hot (N,C) or int (N,); predictions: probabilities
+        (N,C) or int class ids (N,)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        actual = labels.argmax(-1) if labels.ndim == 2 else labels.astype(np.int64)
+        guess = (
+            predictions.argmax(-1) if predictions.ndim == 2 else predictions.astype(np.int64)
+        )
+        if self.confusion is None:
+            k = int(max(actual.max(), guess.max())) + 1
+            self.num_classes = k
+            self.confusion = ConfusionMatrix(k)
+        self.confusion.add_batch(actual, guess)
+
+    # -- metrics -----------------------------------------------------------
+    def _tp(self, c: int) -> int:
+        return self.confusion.count(c, c)
+
+    def accuracy(self) -> float:
+        m = self.confusion
+        return float(np.trace(m.counts)) / max(m.total(), 1)
+
+    def precision(self, cls: int | None = None) -> float:
+        if cls is not None:
+            denominator = self.confusion.predicted_total(cls)
+            return self._tp(cls) / denominator if denominator else 0.0
+        vals = [self.precision(c) for c in range(self.num_classes)]
+        return float(np.mean(vals))
+
+    def recall(self, cls: int | None = None) -> float:
+        if cls is not None:
+            denominator = self.confusion.actual_total(cls)
+            return self._tp(cls) / denominator if denominator else 0.0
+        vals = [self.recall(c) for c in range(self.num_classes)]
+        return float(np.mean(vals))
+
+    def f1(self, cls: int | None = None) -> float:
+        """≙ Evaluation.f1:203 — harmonic mean of precision/recall."""
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def stats(self) -> str:
+        """Text report (≙ Evaluation.stats:81)."""
+        lines = ["==========================Scores=================================="]
+        lines.append(f" Accuracy:  {self.accuracy():.4f}")
+        lines.append(f" Precision: {self.precision():.4f}")
+        lines.append(f" Recall:    {self.recall():.4f}")
+        lines.append(f" F1 Score:  {self.f1():.4f}")
+        lines.append("===========================================================")
+        lines.append("Confusion matrix (rows=actual, cols=predicted):")
+        lines.append(str(self.confusion.counts))
+        return "\n".join(lines)

@@ -1,0 +1,3 @@
+"""Model zoo: MultiLayerNetwork orchestrator + named model builders."""
+
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork  # noqa: F401

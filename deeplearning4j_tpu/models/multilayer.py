@@ -1,0 +1,331 @@
+"""MultiLayerNetwork — the orchestrator.
+
+≙ reference nn/multilayer/MultiLayerNetwork.java:43 (1622 LoC): layer
+construction (init:306-370), greedy layer-wise pretrain (:139-218),
+feedForward (:426-461), finetune (:1024-1080), fit(DataSetIterator) (:999),
+predict (:1089), output (:1184), param pack/unpack (params:762, pack:808,
+unPack:896, setParameters:1420), distributed merge (:1354), reconstruct
+(:1208).
+
+TPU re-design:
+- The network is a thin host-side orchestrator over *pure functions*;
+  parameters live in a list of per-layer pytree dicts, and every compute
+  path (pretrain solver step, finetune step, full-backprop step, forward)
+  is a jitted function cached per batch shape.
+- The reference's backprop machinery (computeDeltas:629, backPropGradient
+  :850, the R-operator family :496,935,1441,1476) is replaced wholesale by
+  ``jax.value_and_grad`` through the feed-forward — including the
+  Hessian-free path, which consumes the forward/loss split via jvp/vjp.
+- Shape adapters between 2-D batches and NHWC conv blocks reproduce the
+  Convolution{Input,Post}Processor reshapes
+  (nn/layers/convolution/preprocessor/*.java) automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import rng as rng_mod
+from deeplearning4j_tpu.datasets.base import DataSet
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.conf import MultiLayerConfig, OptimizationAlgorithm
+from deeplearning4j_tpu.optimize import Solver
+from deeplearning4j_tpu.optimize.api import IterationListener, ModelFunctions
+from deeplearning4j_tpu.utils import tree_math as tm
+
+log = logging.getLogger(__name__)
+
+Params = list[dict[str, jax.Array]]
+
+PRETRAINABLE = {"rbm", "autoencoder"}
+
+
+def _adapt_input(x: jax.Array, layer_type: str, channels: int) -> jax.Array:
+    """Reshape between flat 2-D batches and NHWC conv blocks.
+
+    ≙ ConvolutionInputPreProcessor / ConvolutionPostProcessor — the
+    reference wires these explicitly per layer; here the adapter fires
+    automatically from the layer type and input rank.
+    """
+    if layer_type == "conv_downsample" and x.ndim == 2:
+        side = int(math.isqrt(x.shape[1] // max(channels, 1)))
+        return x.reshape(x.shape[0], side, side, max(channels, 1))
+    if layer_type in ("dense", "output", "rbm", "autoencoder") and x.ndim > 2:
+        return x.reshape(x.shape[0], -1)
+    return x
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfig, params: Params | None = None, seed: int = 123):
+        self.conf = conf
+        self.modules = [L.get(c.layer_type) for c in conf.confs]
+        self.keys = rng_mod.KeyStream(seed)
+        self.params: Params | None = params
+        self.listeners: list[IterationListener] = []
+        self._jit_cache: dict = {}
+
+    # -- construction ------------------------------------------------------
+    def init(self, key: jax.Array | None = None) -> Params:
+        """≙ MultiLayerNetwork.init:306-370."""
+        key = key if key is not None else self.keys.next()
+        subkeys = jax.random.split(key, len(self.modules))
+        self.params = [
+            mod.init(k, c) for mod, c, k in zip(self.modules, self.conf.confs, subkeys)
+        ]
+        return self.params
+
+    def _require_params(self) -> Params:
+        if self.params is None:
+            self.init()
+        return self.params
+
+    # -- forward -----------------------------------------------------------
+    def feed_forward_fn(self, params: Params, x: jax.Array, upto: int | None = None,
+                        key: jax.Array | None = None, training: bool = False) -> list[jax.Array]:
+        """Pure feed-forward returning all activations (≙ feedForward:426)."""
+        acts = [x]
+        n = len(self.modules) if upto is None else upto
+        subkeys = (
+            jax.random.split(key, n) if key is not None else [None] * n
+        )
+        for i in range(n):
+            c = self.conf.confs[i]
+            h = _adapt_input(acts[-1], c.layer_type, c.n_in if c.layer_type == "conv_downsample" else 0)
+            acts.append(
+                self.modules[i].activate(params[i], c, h, key=subkeys[i], training=training)
+            )
+        return acts
+
+    def activation_upto(self, params: Params, x: jax.Array, layer_idx: int) -> jax.Array:
+        """Input to layer ``layer_idx`` (≙ activationFromPrevLayer:417)."""
+        acts = self.feed_forward_fn(params, x, upto=layer_idx)
+        c = self.conf.confs[layer_idx]
+        return _adapt_input(acts[-1], c.layer_type, 0)
+
+    def output(self, x, params: Params | None = None) -> jax.Array:
+        """Class probabilities (≙ output:1184)."""
+        params = params if params is not None else self._require_params()
+        fn = self._cached_jit("output", lambda p, x: self.feed_forward_fn(p, x)[-1])
+        return fn(params, jnp.asarray(x))
+
+    def predict(self, x, params: Params | None = None) -> np.ndarray:
+        """≙ predict:1089."""
+        return np.asarray(jnp.argmax(self.output(x, params), axis=-1))
+
+    def reconstruct(self, x, layer_idx: int | None = None) -> jax.Array:
+        """Decode back from the given layer (≙ reconstruct:1208)."""
+        params = self._require_params()
+        n = layer_idx if layer_idx is not None else len(self.modules) - 1
+        acts = self.feed_forward_fn(params, jnp.asarray(x), upto=n)
+        h = acts[-1]
+        for i in reversed(range(n)):
+            mod, c = self.modules[i], self.conf.confs[i]
+            if hasattr(mod, "prop_down"):
+                h = mod.prop_down(params[i], c, h)
+            elif hasattr(mod, "decode"):
+                h = mod.decode(params[i], c, h)
+            else:
+                w = params[i][L.api.WEIGHT_KEY]
+                h = jax.nn.sigmoid(h @ w.T)
+        return h
+
+    # -- scoring -----------------------------------------------------------
+    def supervised_score_fn(self, params: Params, x, labels, key=None, training=False):
+        """Full-network loss: forward to the last layer's supervised score."""
+        acts = self.feed_forward_fn(params, x, upto=len(self.modules) - 1,
+                                    key=key, training=training)
+        c = self.conf.confs[-1]
+        h = _adapt_input(acts[-1], c.layer_type, 0)
+        return self.modules[-1].supervised_score(
+            params[-1], c, h, labels, key=key, training=training
+        )
+
+    def score(self, dataset: DataSet) -> float:
+        """≙ Model.score on a DataSet."""
+        params = self._require_params()
+        fn = self._cached_jit(
+            "score", lambda p, x, y: self.supervised_score_fn(p, x, y)
+        )
+        return float(fn(params, jnp.asarray(dataset.features), jnp.asarray(dataset.labels)))
+
+    # -- training ----------------------------------------------------------
+    def pretrain(self, iterator) -> None:
+        """Greedy layer-wise pretraining (≙ pretrain:139-218).
+
+        For each pretrainable layer: stream batches, feed them through the
+        already-trained stack, and run that layer's Solver on the batch.
+        """
+        params = self._require_params()
+        for i, (mod, c) in enumerate(zip(self.modules, self.conf.confs)):
+            if c.layer_type not in PRETRAINABLE:
+                continue
+            log.info("pretraining layer %d (%s)", i, c.layer_type)
+            iterator.reset()
+            for batch in iterator:
+                x = jnp.asarray(batch.features)
+                layer_input = self.activation_upto(params, x, i)
+
+                if hasattr(mod, "gradient") and c.layer_type == "rbm":
+                    # CD-k statistics are not autodiff of a scalar: drive a
+                    # plain (adagrad-adjusted) iterated update instead of the
+                    # line-search solvers, inside one jitted while_loop.
+                    params[i] = self._pretrain_cdk(mod, c, params[i], layer_input)
+                else:
+                    model = ModelFunctions(
+                        score_and_grad=lambda p, k, mod=mod, c=c, xi=layer_input: mod.gradient(p, c, xi, k),
+                        score=lambda p, k, mod=mod, c=c, xi=layer_input: mod.score(p, c, xi, k),
+                    )
+                    solver = Solver(c, model, listeners=self.listeners)
+                    params[i], _ = solver.optimize(params[i], self.keys.next())
+
+    def _pretrain_cdk(self, mod, c, layer_params, x):
+        """Jitted CD-k update loop for one batch (≙ the RBM fit path)."""
+        from deeplearning4j_tpu.optimize import updaters
+
+        cache_key = ("cdk", id(mod), c.to_json(), x.shape)
+        if cache_key not in self._jit_cache:
+
+            @jax.jit
+            def run(p, key):
+                state0 = (p, updaters.init(p), 0)
+
+                def body(state, k):
+                    p, ust, it = state
+                    _, grads = mod.gradient(p, c, x, k)
+                    step, ust = updaters.adjust(c, ust, grads, p)
+                    return (tm.sub(p, step), ust, it + 1), None
+
+                keys = jax.random.split(key, c.num_iterations)
+                (p, _, _), _ = jax.lax.scan(body, state0, keys)
+                return p
+
+            self._jit_cache[cache_key] = run
+        return self._jit_cache[cache_key](layer_params, self.keys.next())
+
+    def finetune(self, iterator) -> None:
+        """≙ finetune:1024-1080: fit the output layer on top of frozen
+        features — or, when ``backward``/HESSIAN_FREE is configured, train
+        the whole stack with full backprop."""
+        params = self._require_params()
+        out_conf = self.conf.confs[-1]
+        full_backprop = (
+            self.conf.backward
+            or out_conf.optimization_algo == OptimizationAlgorithm.HESSIAN_FREE
+        )
+        iterator.reset()
+        for batch in iterator:
+            x = jnp.asarray(batch.features)
+            y = jnp.asarray(batch.labels)
+            if full_backprop:
+                model = self._full_model_fns(x, y)
+                solver = Solver(out_conf, model, listeners=self.listeners)
+                new_params, _ = solver.optimize(params, self.keys.next())
+                for i in range(len(params)):
+                    params[i] = new_params[i]
+            else:
+                h = self.activation_upto(params, x, len(self.modules) - 1)
+                mod = self.modules[-1]
+                model = ModelFunctions(
+                    score_and_grad=lambda p, k, h=h, y=y: jax.value_and_grad(
+                        lambda q: mod.supervised_score(q, out_conf, h, y, k, training=True)
+                    )(p),
+                    score=lambda p, k, h=h, y=y: mod.supervised_score(p, out_conf, h, y, k),
+                )
+                solver = Solver(out_conf, model, listeners=self.listeners)
+                params[-1], _ = solver.optimize(params[-1], self.keys.next())
+
+    def _full_model_fns(self, x, y) -> ModelFunctions:
+        """Whole-network ModelFunctions incl. forward/loss split for HF."""
+
+        def score(p, key=None):
+            return self.supervised_score_fn(p, x, y)
+
+        def forward(p):
+            acts = self.feed_forward_fn(p, x, upto=len(self.modules) - 1)
+            c = self.conf.confs[-1]
+            h = _adapt_input(acts[-1], c.layer_type, 0)
+            return self.modules[-1].pre_output(p[-1], c, h)
+
+        c = self.conf.confs[-1]
+        from deeplearning4j_tpu.nn import losses as loss_mod
+
+        def loss_on_outputs(logits):
+            try:
+                return loss_mod.logits_loss(c.loss, y, logits)
+            except ValueError:
+                from deeplearning4j_tpu.nn import activations
+
+                return loss_mod.get(c.loss)(y, activations.get(c.activation)(logits))
+
+        return ModelFunctions.from_score(score, forward=forward, loss_on_outputs=loss_on_outputs)
+
+    def fit(self, iterator) -> None:
+        """≙ fit(DataSetIterator):999 — pretrain (if configured) then finetune."""
+        if self.conf.pretrain:
+            self.pretrain(iterator)
+        iterator.reset()
+        self.finetune(iterator)
+
+    def fit_dataset(self, dataset: DataSet, batch_size: int | None = None) -> None:
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+
+        self.fit(ListDataSetIterator(dataset, batch_size or dataset.num_examples()))
+
+    # -- parameter plumbing ------------------------------------------------
+    def params_vector(self) -> np.ndarray:
+        """Pack all params into one vector (≙ params:762 / pack:808)."""
+        flat, _ = tm.ravel(self._require_params())
+        return np.asarray(flat)
+
+    def set_params_vector(self, vec: np.ndarray) -> None:
+        """≙ setParameters:1420 / unPack:896."""
+        _, unravel = tm.ravel(self._require_params())
+        self.params = unravel(jnp.asarray(vec))
+
+    def merge(self, others: Sequence["MultiLayerNetwork"]) -> None:
+        """Parameter averaging across replicas (≙ merge:1354-1366)."""
+        all_params = [self._require_params()] + [o._require_params() for o in others]
+        n = len(all_params)
+        self.params = jax.tree.map(lambda *xs: sum(xs) / n, *all_params)
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf)
+        if self.params is not None:
+            net.params = jax.tree.map(lambda x: x, self.params)
+        return net
+
+    # -- misc --------------------------------------------------------------
+    def set_listeners(self, listeners: Sequence[IterationListener]) -> None:
+        self.listeners = list(listeners)
+
+    def _cached_jit(self, name: str, fn):
+        if name not in self._jit_cache:
+            self._jit_cache[name] = jax.jit(fn)
+        return self._jit_cache[name]
+
+    # -- serde (≙ MultiLayerNetwork(String conf, INDArray params) resume path :86)
+    def to_bytes(self) -> bytes:
+        import io
+
+        buf = io.BytesIO()
+        flat, _ = tm.ravel(self._require_params())
+        np.savez(buf, params=np.asarray(flat), conf=self.conf.to_json())
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "MultiLayerNetwork":
+        import io
+
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            conf = MultiLayerConfig.from_json(str(z["conf"]))
+            net = cls(conf)
+            net.init()
+            net.set_params_vector(z["params"])
+        return net

@@ -13,7 +13,7 @@ time in conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,6 +21,15 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# Force CPU even when a TPU PJRT plugin (axon) was registered by
+# sitecustomize: the plugin's backend init dials the TPU tunnel, which can
+# block the whole process when the tunnel is down.  Tests are CPU-only by
+# design, so drop the factory before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
 
 import pytest  # noqa: E402
 
