@@ -1,0 +1,86 @@
+"""Benchmark harness: LeNet-MNIST training throughput (samples/sec/chip).
+
+Run on whatever accelerator the default environment exposes (one TPU chip
+under the driver).  Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is the
+ratio against the first recorded value of this harness itself (stored in
+bench_baseline.json next to this file after the first run on TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+BASELINE_FILE = Path(__file__).parent / "bench_baseline.json"
+
+BATCH = 1024
+WARMUP = 10
+STEPS = 30
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets import fetchers
+    from deeplearning4j_tpu.models.lenet import build_lenet, lenet_loss
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel import mesh as mesh_lib
+
+    n_chips = len(jax.devices())
+    mesh = mesh_lib.data_parallel_mesh(n_chips)
+
+    net, params = build_lenet(seed=0)
+    trainer = DataParallelTrainer(lenet_loss(net), mesh=mesh)
+    state = trainer.init(params)
+
+    ds = fetchers.mnist(n=BATCH)
+    x = jnp.asarray(ds.features)
+    y = jnp.asarray(ds.labels)
+    x, y = trainer.shard_batch(x, y)
+
+    for i in range(WARMUP):
+        state, loss = trainer.step(state, x, y, jax.random.key(i))
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, loss = trainer.step(state, x, y, jax.random.key(WARMUP + i))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = BATCH * STEPS / dt
+    per_chip = samples_per_sec / n_chips
+
+    platform = jax.devices()[0].platform
+    records = (
+        json.loads(BASELINE_FILE.read_text()) if BASELINE_FILE.exists() else {}
+    )
+    baseline = records.get(platform, {}).get("samples_per_sec_per_chip")
+    if baseline is None:
+        records[platform] = {
+            "samples_per_sec_per_chip": per_chip,
+            "recorded": time.time(),
+        }
+        BASELINE_FILE.write_text(json.dumps(records))
+    vs_baseline = per_chip / baseline if baseline else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "lenet_mnist_train_samples_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

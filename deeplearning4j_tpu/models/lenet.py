@@ -1,0 +1,63 @@
+"""LeNet-5-style conv net on MNIST — the flagship benchmark model.
+
+≙ the dl4j-examples LeNet-MNIST configuration (BASELINE.json configs[0]);
+the reference's own conv layer was forward-only
+(ConvolutionDownSampleLayer.java:113-121), so this model could never train
+there — here it is fully trainable and is the throughput benchmark.
+
+Layout notes for the MXU: NHWC activations, HWIO kernels, batch and
+channel dims padded by XLA to lane/sublane tiles; with
+``dtypes.MIXED_BF16`` the convs and matmuls run in bfloat16 at 2x rate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn import conf as C
+
+
+def lenet_config(num_classes: int = 10) -> C.MultiLayerConfig:
+    confs = [
+        C.LayerConfig(
+            layer_type="conv_downsample", n_in=1, num_feature_maps=6,
+            filter_size=(5, 5), stride=(2, 2), activation="tanh",
+        ),
+        C.LayerConfig(
+            layer_type="conv_downsample", n_in=6, num_feature_maps=16,
+            filter_size=(5, 5), stride=(2, 2), activation="tanh",
+        ),
+        C.LayerConfig(layer_type="dense", n_in=16 * 4 * 4, n_out=120, activation="tanh"),
+        C.LayerConfig(layer_type="dense", n_in=120, n_out=84, activation="tanh"),
+        C.LayerConfig(
+            layer_type="output", n_in=84, n_out=num_classes,
+            activation="softmax", loss="MCXENT",
+        ),
+    ]
+    return C.MultiLayerConfig(confs=confs, pretrain=False, backward=True)
+
+
+def build_lenet(seed: int = 0) -> tuple[MultiLayerNetwork, list]:
+    net = MultiLayerNetwork(lenet_config(), seed=seed)
+    params = net.init()
+    return net, params
+
+
+def lenet_apply(net: MultiLayerNetwork):
+    """Pure forward: (params, x[B,784] or [B,28,28,1]) -> probabilities."""
+
+    def apply(params, x):
+        return net.feed_forward_fn(params, x)[-1]
+
+    return apply
+
+
+def lenet_loss(net: MultiLayerNetwork):
+    """Pure loss: (params, x, y_onehot, key) -> scalar, for the trainers."""
+
+    def loss(params, x, y, key=None):
+        return net.supervised_score_fn(params, x, y)
+
+    return loss

@@ -1,0 +1,15 @@
+"""Distributed training: device meshes, SPMD data parallelism, local-SGD
+parameter averaging, checkpointing, cluster coordination.
+
+≙ reference L4/L5 (deeplearning4j-scaleout-*): the whole
+MasterActor/WorkerActor/Hazelcast/Spark/YARN parameter-averaging stack
+collapses into jitted SPMD train steps over a ``jax.sharding.Mesh`` with
+XLA collectives over ICI; the StateTracker's blackboard role survives as a
+small host-side ClusterService.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
+    DataParallelTrainer,
+    local_sgd_step,
+)

@@ -1,0 +1,128 @@
+"""Checkpoint / resume.
+
+≙ reference model persistence: the model IS (packed param vector + JSON
+config) (MultiLayerNetwork.params:762 + MultiLayerConfiguration.toJson:125;
+resume via the ``MultiLayerNetwork(conf, params)`` constructor :86), saved
+periodically by ModelSavingActor through pluggable ModelSaver backends
+(ModelSavingActor.java:76-86, DefaultModelSaver.java:19, HdfsModelSaver,
+S3ModelSaver).
+
+TPU re-design: checkpoints are flat-key npz archives (one entry per pytree
+leaf, path-encoded keys) + a JSON manifest — readable with plain numpy, no
+Java serialization.  ``CheckpointManager`` reproduces the save-every-round
+behavior with retention; storage backends stay pluggable (local now;
+object-store adapters live in ``deeplearning4j_tpu.utils.cloud_io``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+SEP = "//"
+
+
+def _flatten(tree: Any) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = SEP.join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save(path: str | Path, params: Any, meta: dict | None = None) -> Path:
+    """Atomic checkpoint write: npz of leaves + structure + manifest."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    treedef = jax.tree.structure(params)
+    payload = _flatten(params)
+    manifest = {
+        "format": "dl4j-tpu-ckpt-v1",
+        "time": time.time(),
+        "treedef": str(treedef),
+        "meta": meta or {},
+        "keys": sorted(payload),
+    }
+    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, __manifest__=json.dumps(manifest), **payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def restore(path: str | Path, like: Any) -> tuple[Any, dict]:
+    """Restore into the structure of ``like``; returns (params, meta)."""
+    with np.load(path, allow_pickle=False) as z:
+        manifest = json.loads(str(z["__manifest__"]))
+        flat = {k: z[k] for k in z.files if k != "__manifest__"}
+    leaves_like = jax.tree_util.tree_flatten_with_path(like)[0]
+    out_leaves = []
+    for path_elems, leaf in leaves_like:
+        key = SEP.join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path_elems
+        )
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"shape mismatch for {key!r}: ckpt {arr.shape} vs model {np.shape(leaf)}"
+            )
+        out_leaves.append(jax.numpy.asarray(arr))
+    treedef = jax.tree.structure(like)
+    return jax.tree.unflatten(treedef, out_leaves), manifest["meta"]
+
+
+class CheckpointManager:
+    """Periodic save with retention (≙ ModelSavingActor round saving)."""
+
+    _PAT = re.compile(r"ckpt_(\d+)\.npz$")
+
+    def __init__(self, directory: str | Path, keep: int = 3, save_every: int = 1):
+        self.directory = Path(directory)
+        self.keep = keep
+        self.save_every = save_every
+        self.directory.mkdir(parents=True, exist_ok=True)
+
+    def maybe_save(self, step: int, params: Any, meta: dict | None = None) -> Path | None:
+        if step % self.save_every != 0:
+            return None
+        p = save(self.directory / f"ckpt_{step}.npz", params, {**(meta or {}), "step": step})
+        self._gc()
+        return p
+
+    def _all_steps(self) -> list[int]:
+        steps = []
+        for f in self.directory.glob("ckpt_*.npz"):
+            m = self._PAT.search(f.name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def _gc(self) -> None:
+        steps = self._all_steps()
+        for s in steps[: -self.keep]:
+            (self.directory / f"ckpt_{s}.npz").unlink(missing_ok=True)
+
+    def latest_step(self) -> int | None:
+        steps = self._all_steps()
+        return steps[-1] if steps else None
+
+    def restore_latest(self, like: Any) -> tuple[Any, dict] | None:
+        s = self.latest_step()
+        if s is None:
+            return None
+        return restore(self.directory / f"ckpt_{s}.npz", like)

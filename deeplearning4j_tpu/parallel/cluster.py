@@ -1,0 +1,195 @@
+"""Host-side cluster coordination: worker registry, heartbeats, eviction,
+early-stopping blackboard, REST status.
+
+≙ the reference's StateTracker role split (SURVEY §5): the *data plane*
+(parameter movement) is gone — it lives in-graph as XLA collectives — but
+the *blackboard* role of ``HazelCastStateTracker``
+(BaseHazelCastStateTracker.java:31-95: worker registry + heartbeats +
+early-stop state + dropwizard REST) survives as this small service.
+
+- Heartbeat/evict semantics mirror the actor runtime: workers re-register
+  every second (WorkerActor.heartbeat:152-170), the master evicts workers
+  silent ≥ ``evict_after`` (MasterActor.java:126-153, 120 s default).
+- Discovery: a pluggable registry.  ``FileRegistry`` covers single-host
+  and shared-filesystem clusters; a ZooKeeper-backed registry drops into
+  the same interface for TPU-VM pods (≙ ZooKeeperConfigurationRegister
+  .java:40 — config serialized at /<host>/<jobid>), gated on a zk client
+  being present.
+- REST status ≙ StateTrackerDropWizardResource.java:29-96
+  (GET /statetracker/{workers,phase,minibatch,numbatchessofar}).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: str
+    last_heartbeat: float = field(default_factory=time.time)
+    meta: dict = field(default_factory=dict)
+
+
+class ClusterService:
+    """In-process blackboard (one per host; the master's is authoritative)."""
+
+    def __init__(self, evict_after: float = 120.0):
+        self.evict_after = evict_after
+        self._workers: dict[str, WorkerInfo] = {}
+        self._lock = threading.Lock()
+        self.phase = "init"
+        self.minibatch = 0
+        self.batches_so_far = 0
+        # early-stopping blackboard (≙ BaseHazelCastStateTracker.java:51-77,562-577)
+        self.best_loss = float("inf")
+        self.patience = 5
+        self.patience_counter = 0
+        self.early_stop = False
+        self._server: ThreadingHTTPServer | None = None
+
+    # -- worker registry / heartbeats -------------------------------------
+    def heartbeat(self, worker_id: str, **meta) -> None:
+        with self._lock:
+            info = self._workers.get(worker_id)
+            if info is None:
+                self._workers[worker_id] = WorkerInfo(worker_id, meta=meta)
+            else:
+                info.last_heartbeat = time.time()
+                info.meta.update(meta)
+
+    def evict_stale(self) -> list[str]:
+        """≙ MasterActor's 1-min sweep evicting workers silent >=120 s."""
+        now = time.time()
+        evicted = []
+        with self._lock:
+            for wid, info in list(self._workers.items()):
+                if now - info.last_heartbeat >= self.evict_after:
+                    del self._workers[wid]
+                    evicted.append(wid)
+        return evicted
+
+    def workers(self) -> list[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    # -- early stopping ----------------------------------------------------
+    def report_loss(self, loss: float) -> bool:
+        """Update the blackboard; returns True when training should stop."""
+        if loss < self.best_loss - 1e-12:
+            self.best_loss = loss
+            self.patience_counter = 0
+        else:
+            self.patience_counter += 1
+            if self.patience_counter >= self.patience:
+                self.early_stop = True
+        return self.early_stop
+
+    # -- status ------------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        return {
+            "phase": self.phase,
+            "workers": self.workers(),
+            "minibatch": self.minibatch,
+            "numbatchessofar": self.batches_so_far,
+            "bestloss": self.best_loss,
+            "earlystop": self.early_stop,
+        }
+
+    # -- REST (≙ StateTrackerDropWizardResource) ---------------------------
+    def start_rest_api(self, port: int = 0) -> int:
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                status = service.status()
+                if len(parts) == 2 and parts[0] == "statetracker":
+                    payload = status.get(parts[1])
+                    if payload is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                else:
+                    payload = status
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        thread.start()
+        return self._server.server_address[1]
+
+    def stop_rest_api(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server = None
+
+
+class FileRegistry:
+    """Worker discovery via a shared directory.
+
+    ≙ ZooKeeperConfigurationRegister semantics (serialized config at a
+    well-known path, workers poll to retrieve) for environments without a
+    ZK ensemble; the interface matches the ZooKeeper variant.
+    """
+
+    def __init__(self, root: str | Path, job_id: str):
+        self.root = Path(root) / job_id
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def register_master(self, config: dict) -> None:
+        (self.root / "master.json").write_text(json.dumps(config))
+
+    def retrieve_master(self, timeout: float = 30.0) -> dict:
+        deadline = time.time() + timeout
+        path = self.root / "master.json"
+        while time.time() < deadline:
+            if path.exists():
+                return json.loads(path.read_text())
+            time.sleep(0.2)
+        raise TimeoutError(f"no master registered under {self.root}")
+
+    def register_worker(self, worker_id: str, info: dict | None = None) -> None:
+        (self.root / f"worker_{worker_id}.json").write_text(json.dumps(info or {}))
+
+    def list_workers(self) -> list[str]:
+        return sorted(
+            p.stem.removeprefix("worker_") for p in self.root.glob("worker_*.json")
+        )
+
+
+def initialize_distributed(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host SPMD bring-up: ``jax.distributed.initialize``.
+
+    ≙ DeepLearning4jDistributed.setup's master/worker boot
+    (DeepLearning4jDistributed.java:187-306) — but after this single call
+    every host runs the *same* program and XLA handles all cross-host
+    traffic (ICI/DCN); there is no master JVM.
+    """
+    kwargs = {}
+    if coordinator is not None:
+        kwargs = dict(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    import jax
+
+    jax.distributed.initialize(**kwargs)

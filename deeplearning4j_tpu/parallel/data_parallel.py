@@ -1,0 +1,149 @@
+"""SPMD data-parallel training.
+
+Two modes, mirroring the reference's two synchronization policies
+(SURVEY §2 P1/P2):
+
+1. **Per-step gradient AllReduce** (the TPU north star): one jitted train
+   step with the batch sharded over the mesh's data axis and parameters
+   replicated.  XLA inserts the AllReduce over ICI — this is the in-graph
+   equivalent of the whole IterativeReduce master/worker round trip
+   (IterativeReduceWorkRouter.java:30-40 + INDArrayAggregator.java:19-43 +
+   MasterActor heartbeat), with the barrier cost reduced from ~1 s of
+   actor messaging to microseconds of ICI traffic.
+
+2. **Local SGD with parameter averaging** (faithful compatibility mode):
+   each device runs k local SGD steps on its own shard, then parameters
+   are averaged — exactly the reference's parameter-averaging semantics
+   (workers fit locally, master averages ``network.params()``:
+   SparkDl4jMultiLayer.java:144-148, yarn Master.compute:47-62).
+   Implemented as a ``shard_map`` whose per-device body is a
+   ``lax.scan`` of local steps followed by ``pmean`` — still one compiled
+   program, no host round-trips.
+
+The reference's asynchronous Hogwild router (HogWildWorkRouter.java:14-31)
+is deliberately *not* reproduced: on TPU the synchronous barrier is
+effectively free over ICI, so async parameter sharing buys staleness and
+non-determinism for nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from deeplearning4j_tpu.parallel import mesh as mesh_lib
+from deeplearning4j_tpu.utils import tree_math as tm
+
+LossFn = Callable[..., jax.Array]  # (params, batch_x, batch_y, key) -> scalar
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+class DataParallelTrainer:
+    """Per-step gradient-AllReduce trainer (mode 1)."""
+
+    def __init__(
+        self,
+        loss_fn: LossFn,
+        mesh=None,
+        optimizer: optax.GradientTransformation | None = None,
+        donate: bool = True,
+    ):
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
+        self.optimizer = optimizer or optax.sgd(1e-2, momentum=0.9)
+        repl = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS))
+
+        def step(state: TrainState, x, y, key):
+            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, x, y, key)
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(params, opt_state, state.step + 1), loss
+
+        self._step = jax.jit(
+            step,
+            in_shardings=(repl, shard, shard, repl),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    def init(self, params) -> TrainState:
+        # copy params: the jitted step donates its input state, and the
+        # caller's arrays must survive (donation would delete them)
+        params = jax.tree.map(lambda x: jnp.array(x, copy=True), params)
+        state = TrainState(
+            params=params,
+            opt_state=self.optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        repl = NamedSharding(self.mesh, P())
+        return jax.device_put(state, repl)
+
+    def shard_batch(self, x, y):
+        shard = NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS))
+        return jax.device_put(x, shard), jax.device_put(y, shard)
+
+    def step(self, state: TrainState, x, y, key) -> tuple[TrainState, jax.Array]:
+        return self._step(state, x, y, key)
+
+
+def local_sgd_step(
+    loss_fn: LossFn,
+    mesh,
+    local_steps: int = 1,
+    lr: float = 0.1,
+    average_every_step: bool = True,
+):
+    """Build a jitted local-SGD-with-parameter-averaging step (mode 2).
+
+    Each device: ``local_steps`` SGD steps on its batch shard, then a
+    cross-device parameter ``pmean`` — the reference's
+    averaging-of-parameters-after-k-local-iterations semantics
+    (≙ Spark fitDataSet round / YARN superstep).  Returns
+    ``step(params, x, y, key) -> (params, mean_loss)``; ``x``/``y`` carry
+    the *global* batch, split across devices on the leading axis.
+    """
+    axis = mesh_lib.DATA_AXIS
+
+    def per_device(params, x, y, key):
+        def one(carry, k):
+            p = carry
+            loss, g = jax.value_and_grad(loss_fn)(p, x, y, k)
+            p = jax.tree.map(lambda pi, gi: pi - lr * gi, p, g)
+            return p, loss
+
+        keys = jax.random.split(key, local_steps)
+        params, losses = lax.scan(one, params, keys)
+        if average_every_step:
+            params = lax.pmean(params, axis)
+        return params, lax.pmean(jnp.mean(losses), axis)
+
+    smapped = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped)
+
+
+def replica_consensus(params_tree) -> jax.Array:
+    """Max abs cross-replica parameter divergence — a guard the reference
+    could never express (its replicas lived in different JVMs)."""
+    leaves = jax.tree.leaves(params_tree)
+    return max(jnp.max(jnp.abs(leaf - leaf[0:1])) for leaf in leaves)

@@ -1,0 +1,49 @@
+"""Device-mesh helpers.
+
+The mesh is the TPU-native replacement for the reference's worker
+registry: where the Akka runtime tracked JVMs in a Hazelcast map
+(BaseHazelCastStateTracker.java:37-95), an SPMD program simply lays its
+computation over a ``jax.sharding.Mesh`` whose axes name the parallelism
+dimensions (data / model / pipeline); XLA then compiles gradient
+synchronization to AllReduce over ICI.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def data_parallel_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over all (or the first n) local devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def dp_mp_mesh(dp: int, mp: int) -> Mesh:
+    """2-D (data, model) mesh — tensor-parallel hooks beyond parity."""
+    devs = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
+    return Mesh(devs, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Device-put a host batch with its leading axis split over the mesh."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, batch_sharding(mesh)), batch
+    )

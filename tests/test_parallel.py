@@ -1,0 +1,177 @@
+"""Distributed-path tests on the 8-virtual-device CPU mesh — the
+fake-cluster technique ≙ reference BaseTestDistributed / IRUnitDriver /
+Spark local[8] (SURVEY §4.3)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import fetchers
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.models.lenet import build_lenet, lenet_loss
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.parallel import (
+    DataParallelTrainer,
+    data_parallel_mesh,
+    local_sgd_step,
+)
+from deeplearning4j_tpu.parallel import checkpoint as ckpt
+from deeplearning4j_tpu.parallel.cluster import ClusterService, FileRegistry
+
+
+def _small_mlp():
+    mc = C.list_builder(
+        C.LayerConfig(activation="tanh"), sizes=[16], n_in=8, n_out=3,
+        pretrain=False, backward=True,
+    )
+    net = MultiLayerNetwork(mc, seed=0)
+    params = net.init()
+
+    def loss(params, x, y, key=None):
+        return net.supervised_score_fn(params, x, y)
+
+    return net, params, loss
+
+
+def _toy_batch(n=64, d=8, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, k))
+    y = np.eye(k, dtype=np.float32)[(x @ w).argmax(1)]
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_data_parallel_trainer_reduces_loss(devices):
+    net, params, loss = _small_mlp()
+    mesh = data_parallel_mesh(8)
+    trainer = DataParallelTrainer(loss, mesh=mesh)
+    state = trainer.init(params)
+    x, y = _toy_batch(256)
+    x, y = trainer.shard_batch(x, y)
+    losses = []
+    for i in range(60):
+        state, l = trainer.step(state, x, y, jax.random.key(i))
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_data_parallel_matches_single_device():
+    """Gradient AllReduce over 8 shards == single-device full batch."""
+    net, params, loss = _small_mlp()
+    x, y = _toy_batch(64)
+
+    import optax
+
+    opt = optax.sgd(0.1)
+    t8 = DataParallelTrainer(loss, mesh=data_parallel_mesh(8), optimizer=opt)
+    t1 = DataParallelTrainer(loss, mesh=data_parallel_mesh(1), optimizer=opt)
+    s8 = t8.init(params)
+    s1 = t1.init(params)
+    for i in range(5):
+        k = jax.random.key(i)
+        s8, l8 = t8.step(s8, *t8.shard_batch(x, y), k)
+        s1, l1 = t1.step(s1, *t1.shard_batch(x, y), k)
+    assert abs(float(l8) - float(l1)) < 1e-4
+    for a, b in zip(jax.tree.leaves(s8.params), jax.tree.leaves(s1.params)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_local_sgd_parameter_averaging(devices):
+    """Local-SGD mode reproduces parameter-averaging semantics: after the
+    averaged step, all devices agree and loss decreases."""
+    net, params, loss = _small_mlp()
+    mesh = data_parallel_mesh(8)
+    step = local_sgd_step(loss, mesh, local_steps=4, lr=0.2)
+    x, y = _toy_batch(256)
+    l_first = None
+    for i in range(20):
+        params, l = step(params, x, y, jax.random.key(i))
+        if l_first is None:
+            l_first = float(l)
+    assert float(l) < l_first * 0.7
+
+
+def test_checkpoint_roundtrip_and_manager(tmp_path):
+    net, params, _ = _small_mlp()
+    p = ckpt.save(tmp_path / "model.npz", params, {"note": "hi"})
+    like = jax.tree.map(jnp.zeros_like, params)
+    restored, meta = ckpt.restore(p, like)
+    assert meta["note"] == "hi"
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        assert np.allclose(np.asarray(a), np.asarray(b))
+
+    mgr = ckpt.CheckpointManager(tmp_path / "ckpts", keep=2, save_every=2)
+    for step in range(1, 9):
+        mgr.maybe_save(step, params, {"step": step})
+    assert mgr.latest_step() == 8
+    assert len(list((tmp_path / "ckpts").glob("ckpt_*.npz"))) == 2
+    restored, meta = mgr.restore_latest(like)
+    assert meta["step"] == 8
+
+
+def test_cluster_service_heartbeat_evict_earlystop():
+    svc = ClusterService(evict_after=0.2)
+    svc.heartbeat("w0")
+    svc.heartbeat("w1")
+    assert svc.workers() == ["w0", "w1"]
+    time.sleep(0.25)
+    svc.heartbeat("w1")  # w1 stays fresh
+    evicted = svc.evict_stale()
+    assert evicted == ["w0"]
+    assert svc.workers() == ["w1"]
+
+    svc.patience = 2
+    assert not svc.report_loss(1.0)
+    assert not svc.report_loss(0.9)
+    assert not svc.report_loss(0.95)
+    assert svc.report_loss(0.95)  # patience exhausted
+    assert svc.early_stop
+
+
+def test_cluster_rest_api():
+    import json
+    import urllib.request
+
+    svc = ClusterService()
+    svc.heartbeat("worker-a")
+    svc.phase = "finetune"
+    port = svc.start_rest_api()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/statetracker/workers") as r:
+            assert json.loads(r.read()) == ["worker-a"]
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/statetracker/phase") as r:
+            assert json.loads(r.read()) == "finetune"
+    finally:
+        svc.stop_rest_api()
+
+
+def test_file_registry_discovery(tmp_path):
+    master = FileRegistry(tmp_path, "job1")
+    master.register_master({"coordinator": "host:1234"})
+    worker = FileRegistry(tmp_path, "job1")
+    conf = worker.retrieve_master(timeout=2)
+    assert conf["coordinator"] == "host:1234"
+    worker.register_worker("w0", {"devices": 8})
+    assert master.list_workers() == ["w0"]
+
+
+def test_lenet_trains_data_parallel(devices):
+    """Flagship model one full DP step on the 8-device mesh + loss drop."""
+    net, params = build_lenet(seed=0)
+    loss = lenet_loss(net)
+    mesh = data_parallel_mesh(8)
+    trainer = DataParallelTrainer(loss, mesh=mesh)
+    state = trainer.init(params)
+    ds = fetchers.mnist(n=256)
+    x = jnp.asarray(ds.features)
+    y = jnp.asarray(ds.labels)
+    x, y = trainer.shard_batch(x, y)
+    l0 = None
+    for i in range(12):
+        state, l = trainer.step(state, x, y, jax.random.key(i))
+        if l0 is None:
+            l0 = float(l)
+    assert float(l) < l0, (l0, float(l))
