@@ -1,0 +1,159 @@
+"""GloVe: AdaGrad weighted least squares on co-occurrence log-counts.
+
+≙ reference models/glove/Glove.java:42 (fit:91, doIteration:151),
+GloveWeightLookupTable (bias vectors + per-row AdaGrad), and
+CoOccurrences.java:41 (window-weighted co-occurrence counting, the actor
+pipeline replaced by a plain host-side pass).
+
+TPU re-design: co-occurrence triples (i, j, X_ij) are counted host-side
+once, then shuffled into fixed-size batches; each epoch's updates run as
+jitted scatter-add AdaGrad steps — the batched equivalent of the
+reference's per-pair ``iterateSample`` loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterator import SentenceIterator
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizer
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+def count_cooccurrences(
+    encoded_sentences, window: int = 5
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Window-weighted counts (weight 1/distance, ≙ CoOccurrences.fit:69).
+
+    Returns (rows, cols, values) for the upper+lower triangle.
+    """
+    counts: Counter = Counter()
+    for ids in encoded_sentences:
+        n = len(ids)
+        for i in range(n):
+            for off in range(1, window + 1):
+                j = i + off
+                if j < n:
+                    counts[(ids[i], ids[j])] += 1.0 / off
+                    counts[(ids[j], ids[i])] += 1.0 / off
+    if not counts:
+        return (np.zeros(0, np.int32),) * 2 + (np.zeros(0, np.float32),)
+    keys = np.array(list(counts.keys()), dtype=np.int32)
+    vals = np.array(list(counts.values()), dtype=np.float32)
+    return keys[:, 0], keys[:, 1], vals
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
+    """One batched AdaGrad WLS step.
+
+    w/wc: word and context embeddings (V, D); b/bc biases (V,);
+    h*: AdaGrad accumulators.  loss = f(X) * (w_i.wc_j + b_i + bc_j - logX)^2
+    """
+    wi = w[rows]
+    wj = wc[cols]
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols] - logx
+    fdiff = fx * diff  # (B,)
+    g_wi = fdiff[:, None] * wj
+    g_wj = fdiff[:, None] * wi
+    # AdaGrad per-row
+    hw = hw.at[rows].add(g_wi**2)
+    hwc = hwc.at[cols].add(g_wj**2)
+    w = w.at[rows].add(-lr * g_wi / jnp.sqrt(hw[rows] + 1e-8))
+    wc = wc.at[cols].add(-lr * g_wj / jnp.sqrt(hwc[cols] + 1e-8))
+    hb = hb.at[rows].add(fdiff**2)
+    hbc = hbc.at[cols].add(fdiff**2)
+    b = b.at[rows].add(-lr * fdiff / jnp.sqrt(hb[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * fdiff / jnp.sqrt(hbc[cols] + 1e-8))
+    loss = 0.5 * jnp.mean(fx * diff**2)
+    return w, wc, b, bc, hw, hwc, hb, hbc, loss
+
+
+class Glove:
+    """≙ Glove.Builder fields: layer_size, xMax, alpha, lr, epochs."""
+
+    def __init__(
+        self,
+        layer_size: int = 50,
+        window: int = 5,
+        min_word_frequency: int = 1,
+        lr: float = 0.05,
+        x_max: float = 100.0,
+        alpha: float = 0.75,
+        epochs: int = 5,
+        batch: int = 4096,
+        seed: int = 123,
+        tokenizer=None,
+    ):
+        self.layer_size = layer_size
+        self.window = window
+        self.lr = lr
+        self.x_max = x_max
+        self.alpha = alpha
+        self.epochs = epochs
+        self.batch = batch
+        self.seed = seed
+        self.tokenizer = tokenizer or DefaultTokenizer()
+        self.cache = VocabCache(min_word_frequency)
+        self.w = self.wc = self.b = self.bc = None
+        self.loss_history: list[float] = []
+
+    def fit(self, sentences: SentenceIterator) -> None:
+        toks = [self.tokenizer.tokens(s) for s in sentences]
+        self.cache.fit(toks)
+        encoded = [self.cache.encode(t) for t in toks]
+        rows, cols, vals = count_cooccurrences(encoded, self.window)
+        if len(rows) == 0:
+            raise ValueError("empty co-occurrence matrix")
+
+        v, d = len(self.cache), self.layer_size
+        key = jax.random.key(self.seed)
+        k1, k2 = jax.random.split(key)
+        self.w = (jax.random.uniform(k1, (v, d)) - 0.5) / d
+        self.wc = (jax.random.uniform(k2, (v, d)) - 0.5) / d
+        self.b = jnp.zeros((v,))
+        self.bc = jnp.zeros((v,))
+        hw = jnp.ones((v, d))
+        hwc = jnp.ones((v, d))
+        hb = jnp.ones((v,))
+        hbc = jnp.ones((v,))
+
+        logx = np.log(vals)
+        fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+        rng = np.random.default_rng(self.seed)
+        n = len(rows)
+        bsz = min(self.batch, n)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            epoch_loss, nb = 0.0, 0
+            for s in range(0, n - bsz + 1, bsz):
+                idx = order[s : s + bsz]
+                (self.w, self.wc, self.b, self.bc, hw, hwc, hb, hbc, loss) = _glove_step(
+                    self.w, self.wc, self.b, self.bc, hw, hwc, hb, hbc,
+                    jnp.asarray(rows[idx]), jnp.asarray(cols[idx]),
+                    jnp.asarray(logx[idx]), jnp.asarray(fx[idx]),
+                    jnp.float32(self.lr),
+                )
+                epoch_loss += float(loss)
+                nb += 1
+            self.loss_history.append(epoch_loss / max(nb, 1))
+
+    # combined representation (standard GloVe: w + wc)
+    @property
+    def syn0(self):
+        return self.w + self.wc
+
+    def get_word_vector(self, word: str) -> np.ndarray | None:
+        i = self.cache.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))

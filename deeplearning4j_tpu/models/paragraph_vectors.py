@@ -1,0 +1,87 @@
+"""ParagraphVectors (doc2vec): PV-DBOW / PV-DM over labeled documents.
+
+≙ reference models/paragraphvectors/ParagraphVectors.java:37-480
+(trainSentence:149, dbow:172): label (paragraph) vectors are trained
+against the words of their windows through the same hierarchical-softmax
+path as Word2Vec; ``train_words=False`` freezes word vectors (pure DBOW).
+
+TPU re-design: label rows live in a separate ``syn0_labels`` matrix; each
+batch is the same jitted HS scatter-add kernel as Word2Vec with inputs
+taken from the label matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.word2vec import Word2Vec, _hs_math, skipgram_pairs
+
+
+class ParagraphVectors(Word2Vec):
+    def __init__(self, train_words: bool = True, **kw):
+        super().__init__(**kw)
+        self.train_words = train_words
+        self.labels: dict[str, int] = {}
+        self.syn0_labels: jax.Array | None = None
+
+    def fit_labeled(self, labeled_sentences) -> None:
+        """labeled_sentences: iterable of (label, sentence) pairs
+        (e.g. LabelAwareSentenceIterator)."""
+        pairs = list(labeled_sentences)
+        from deeplearning4j_tpu.nlp.sentence_iterator import CollectionSentenceIterator
+
+        sents = CollectionSentenceIterator([s for _, s in pairs])
+        if len(self.cache) == 0:
+            self.build_vocab(sents)
+        if self.syn0 is None:
+            self.reset_weights()
+        for label, _ in pairs:
+            if label not in self.labels:
+                self.labels[label] = len(self.labels)
+        key = jax.random.key(self.seed + 1)
+        self.syn0_labels = (
+            jax.random.uniform(key, (len(self.labels), self.layer_size)) - 0.5
+        ) / self.layer_size
+
+        if self.train_words:
+            self.fit(sents)
+
+        codes = jnp.asarray(self._codes)
+        points = jnp.asarray(self._points)
+        mask = jnp.asarray(self._mask)
+        rng = np.random.default_rng(self.seed)
+        step = jax.jit(_hs_math, donate_argnums=(0, 1))
+
+        for _ in range(self.epochs):
+            for label, sent in pairs:
+                ids = self.cache.encode(self.tokenize(sent))
+                if not ids:
+                    continue
+                # PV-DBOW: the label vector predicts every word in the doc
+                # (≙ ParagraphVectors.dbow:172)
+                tgts = np.asarray(ids, np.int32)
+                ins = np.full(len(ids), self.labels[label], np.int32)
+                self.syn0_labels, self.syn1 = step(
+                    self.syn0_labels, self.syn1,
+                    jnp.asarray(ins), codes[tgts], points[tgts], mask[tgts],
+                    jnp.float32(self.lr),
+                )
+
+    def get_label_vector(self, label: str) -> np.ndarray | None:
+        i = self.labels.get(label)
+        return None if i is None else np.asarray(self.syn0_labels[i])
+
+    def infer_nearest_label(self, sentence: str) -> str | None:
+        """Classify by cosine between doc's mean word vector and labels."""
+        ids = self.cache.encode(self.tokenize(sentence))
+        if not ids or not self.labels:
+            return None
+        doc = np.asarray(self.syn0)[ids].mean(0)
+        mat = np.asarray(self.syn0_labels)
+        sims = mat @ doc / (
+            np.linalg.norm(mat, axis=1) * np.linalg.norm(doc) + 1e-9
+        )
+        inv = {v: k for k, v in self.labels.items()}
+        return inv[int(np.argmax(sims))]

@@ -1,0 +1,7 @@
+"""NLP stack: text pipeline (tokenizers, sentence/document iterators,
+vocab, Huffman coding, inverted index, vectorizers) + embedding models.
+
+≙ reference deeplearning4j-nlp (~17.3k LoC, SURVEY §1-L7): the text
+pipeline feeds Word2Vec / GloVe / ParagraphVectors (which bypass the L1
+layer stack and write embedding matrices directly) and RNTN.
+"""

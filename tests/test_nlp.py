@@ -1,0 +1,201 @@
+"""NLP-stack tests ≙ reference Word2VecTests (similarity bounds),
+tokenizer tests, TF-IDF tests, Huffman correctness."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import serializer
+from deeplearning4j_tpu.nlp.inverted_index import InvertedIndex
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    LabelAwareSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.stopwords import remove_stop_words
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizer,
+    NGramTokenizer,
+    input_homogenization,
+    split_sentences,
+)
+from deeplearning4j_tpu.nlp.vectorizers import BagOfWordsVectorizer, TfidfVectorizer, windows
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.models.glove import Glove, count_cooccurrences
+from deeplearning4j_tpu.models.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.models.word2vec import Word2Vec, skipgram_pairs
+
+
+def _synthetic_corpus(n=300, seed=0):
+    """Two topic clusters: day/sun/light/morning vs night/moon/dark/evening.
+
+    Gives similarity structure a correct Word2Vec must recover
+    (≙ Word2VecTests asserting similarity('day','night') bounds)."""
+    rng = np.random.default_rng(seed)
+    day = ["day", "sun", "light", "morning", "bright", "noon"]
+    night = ["night", "moon", "dark", "evening", "stars", "midnight"]
+    fillers = ["the", "a", "was", "very", "and", "it", "sky", "time"]
+    sents = []
+    for _ in range(n):
+        topic = day if rng.random() < 0.5 else night
+        words = list(rng.choice(topic, size=4)) + list(rng.choice(fillers, size=3))
+        rng.shuffle(words)
+        sents.append(" ".join(words))
+    return sents
+
+
+def test_tokenizer_and_homogenization():
+    t = DefaultTokenizer()
+    assert t.tokens("Hello, World! it's fine.") == ["hello", "world", "it's", "fine"]
+    assert input_homogenization("Café, DÉJÀ-vu!") == "cafe  deja vu "
+    ng = NGramTokenizer(DefaultTokenizer(), 1, 2)
+    toks = ng.tokens("a b c")
+    assert "a b" in toks and "b c" in toks and "a" in toks
+    assert split_sentences("One. Two! Three?") == ["One.", "Two!", "Three?"]
+    assert remove_stop_words(["the", "cat", "and", "dog"]) == ["cat", "dog"]
+
+
+def test_sentence_iterators(tmp_path):
+    ci = CollectionSentenceIterator(["a b", "c d"])
+    assert list(ci) == ["a b", "c d"]
+    p = tmp_path / "text.txt"
+    p.write_text("line one\n\nline two\n")
+    li = LineSentenceIterator(p)
+    assert list(li) == ["line one", "line two"]
+
+    root = tmp_path / "corpus"
+    (root / "pos").mkdir(parents=True)
+    (root / "neg").mkdir()
+    (root / "pos" / "a.txt").write_text("Good stuff. Nice thing.")
+    (root / "neg" / "b.txt").write_text("Bad stuff.")
+    la = LabelAwareSentenceIterator(root)
+    pairs = list(la)
+    assert ("neg", "Bad stuff.") in pairs
+    assert sum(1 for label, _ in pairs if label == "pos") == 2
+
+
+def test_vocab_and_huffman():
+    cache = VocabCache(min_word_frequency=1)
+    cache.fit([["a", "a", "a", "b", "b", "c"]])
+    cache.build_huffman()
+    assert len(cache) == 3
+    # most frequent word gets the shortest code
+    assert len(cache.vocab["a"].codes) <= len(cache.vocab["c"].codes)
+    codes, points, mask = cache.huffman_arrays()
+    assert codes.shape == points.shape == mask.shape
+    assert mask.sum() == sum(len(v.codes) for v in cache.vocab.values())
+    # prefix-free: no word's code is another's prefix
+    all_codes = ["".join(map(str, cache.vocab[w].codes)) for w in cache.words()]
+    for i, a in enumerate(all_codes):
+        for j, b in enumerate(all_codes):
+            if i != j:
+                assert not b.startswith(a)
+    table = cache.unigram_table(size=1000)
+    assert (np.bincount(table, minlength=3).argmax()) == cache.index_of("a")
+
+
+def test_skipgram_pairs_window():
+    rng = np.random.default_rng(0)
+    ins, tgts = skipgram_pairs([1, 2, 3, 4], window=2, rng=rng)
+    assert len(ins) == len(tgts) > 0
+    assert set(ins) <= {1, 2, 3, 4}
+
+
+def test_inverted_index():
+    idx = InvertedIndex()
+    idx.add_document(["a", "b"])
+    idx.add_document(["b", "c"])
+    assert idx.documents("b") == [0, 1]
+    assert idx.doc_frequency("a") == 1
+    assert idx.document(1) == ["b", "c"]
+
+
+def test_bow_and_tfidf():
+    texts = ["the cat sat", "the dog sat", "the cat ran"]
+    bow = BagOfWordsVectorizer().fit(texts)
+    m = bow.transform(texts)
+    assert m.shape == (3, len(bow.cache))
+    assert m[0, bow.cache.index_of("cat")] == 1
+
+    tfidf = TfidfVectorizer().fit(texts)
+    t = tfidf.transform(texts)
+    # 'the' appears everywhere -> lowest idf weight
+    the_col = tfidf.cache.index_of("the")
+    cat_col = tfidf.cache.index_of("cat")
+    assert t[0, the_col] < t[0, cat_col]
+
+    w = windows(["a", "b", "c"], window_size=3)
+    assert len(w) == 3 and w[0] == ["<NONE>", "a", "b"]
+
+
+def test_word2vec_learns_topic_similarity():
+    """≙ Word2VecTests.testRunWord2Vec similarity assertions."""
+    sents = _synthetic_corpus(400)
+    w2v = Word2Vec(layer_size=32, window=5, epochs=8, lr=0.05, seed=1)
+    w2v.fit(CollectionSentenceIterator(sents))
+    sim_same = w2v.similarity("day", "sun")
+    sim_cross = w2v.similarity("day", "moon")
+    assert sim_same > sim_cross, (sim_same, sim_cross)
+    near = w2v.words_nearest("night", top=5)
+    night_topic = {"moon", "dark", "evening", "stars", "midnight"}
+    assert len(night_topic & set(near)) >= 2, near
+
+
+def test_word2vec_negative_sampling_path():
+    sents = _synthetic_corpus(200)
+    w2v = Word2Vec(
+        layer_size=16, window=3, epochs=4, lr=0.05,
+        use_hierarchical_softmax=False, negative=5, seed=2,
+    )
+    w2v.fit(CollectionSentenceIterator(sents))
+    assert np.isfinite(np.asarray(w2v.syn0)).all()
+    assert w2v.similarity("day", "sun") > w2v.similarity("day", "midnight")
+
+
+def test_word2vec_distributed_matches_semantics(devices):
+    """Sharded-delta-average path (≙ Word2VecPerformer/JobAggregator)."""
+    from deeplearning4j_tpu.parallel import data_parallel_mesh
+
+    sents = _synthetic_corpus(200)
+    w2v = Word2Vec(layer_size=16, window=3, epochs=4, lr=0.05, seed=3, batch_pairs=1024)
+    w2v.build_vocab(CollectionSentenceIterator(sents))
+    w2v.reset_weights()
+    w2v.fit_distributed(CollectionSentenceIterator(sents), mesh=data_parallel_mesh(8))
+    assert np.isfinite(np.asarray(w2v.syn0)).all()
+    assert np.abs(np.asarray(w2v.syn0)).max() > 1e-4  # actually trained
+
+
+def test_serializer_roundtrips(tmp_path):
+    words = ["alpha", "beta"]
+    vecs = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], dtype=np.float32)
+    serializer.write_text(tmp_path / "v.txt", words, vecs)
+    w2, v2 = serializer.read_text(tmp_path / "v.txt")
+    assert w2 == words and np.allclose(v2, vecs, atol=1e-5)
+
+    serializer.write_binary(tmp_path / "v.bin", words, vecs)
+    w3, v3 = serializer.read_binary(tmp_path / "v.bin")
+    assert w3 == words and np.allclose(v3, vecs)
+
+    m = serializer.load_into_word2vec(Word2Vec, words, vecs)
+    assert np.allclose(m.get_word_vector("beta"), [4, 5, 6])
+
+
+def test_glove_learns_cooccurrence_structure():
+    rows, cols, vals = count_cooccurrences([[0, 1, 2], [0, 1]], window=2)
+    assert len(rows) > 0
+    g = Glove(layer_size=16, window=4, epochs=30, lr=0.05, batch=512, seed=4)
+    g.fit(CollectionSentenceIterator(_synthetic_corpus(200)))
+    assert g.loss_history[-1] < g.loss_history[0]
+    assert g.similarity("day", "sun") > g.similarity("day", "moon")
+
+
+def test_paragraph_vectors_dbow():
+    rng = np.random.default_rng(5)
+    pairs = []
+    for _ in range(100):
+        pairs.append(("daytime", " ".join(rng.choice(["day", "sun", "light", "bright"], 5))))
+        pairs.append(("nighttime", " ".join(rng.choice(["night", "moon", "dark", "stars"], 5))))
+    pv = ParagraphVectors(layer_size=16, epochs=6, lr=0.05, seed=6, train_words=True)
+    pv.fit_labeled(pairs)
+    assert pv.get_label_vector("daytime") is not None
+    assert pv.infer_nearest_label("sun light bright day") == "daytime"
+    assert pv.infer_nearest_label("moon stars dark night") == "nighttime"
