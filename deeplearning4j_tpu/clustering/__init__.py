@@ -1,0 +1,11 @@
+"""Clustering + spatial indexes: KMeans (jitted Lloyd iterations), KDTree,
+QuadTree (Barnes-Hut support), VPTree.
+
+≙ reference clustering/ (~1800 LoC): KMeansClustering.java:112,
+KDTree.java:351, QuadTree.java:475, VPTree.java:290.
+"""
+
+from deeplearning4j_tpu.clustering.kmeans import KMeans  # noqa: F401
+from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.quadtree import QuadTree  # noqa: F401
+from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401

@@ -1,0 +1,72 @@
+"""KMeans with jitted Lloyd iterations.
+
+≙ reference clustering/kmeans/KMeansClustering.java:112.  The
+assignment + centroid-update step is one jitted function (distance matrix
+on the MXU, segment-sum centroid update); k-means++ seeding host-side.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(x, centroids, k):
+    d2 = (
+        jnp.sum(x**2, 1, keepdims=True)
+        - 2 * x @ centroids.T
+        + jnp.sum(centroids**2, 1)[None, :]
+    )
+    assign = jnp.argmin(d2, axis=1)
+    sums = jax.ops.segment_sum(x, assign, num_segments=k)
+    counts = jax.ops.segment_sum(jnp.ones(x.shape[0]), assign, num_segments=k)
+    new_centroids = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1), centroids
+    )
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    return new_centroids, assign, inertia
+
+
+class KMeans:
+    def __init__(self, k: int, max_iter: int = 100, tol: float = 1e-6, seed: int = 0):
+        self.k = k
+        self.max_iter = max_iter
+        self.tol = tol
+        self.seed = seed
+        self.centroids: np.ndarray | None = None
+        self.inertia: float = float("inf")
+
+    def _init_pp(self, x: np.ndarray) -> np.ndarray:
+        """k-means++ seeding."""
+        rng = np.random.default_rng(self.seed)
+        n = x.shape[0]
+        centroids = [x[rng.integers(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                ((x[:, None, :] - np.stack(centroids)[None]) ** 2).sum(-1), axis=1
+            )
+            probs = d2 / (d2.sum() + 1e-12)
+            centroids.append(x[rng.choice(n, p=probs)])
+        return np.stack(centroids)
+
+    def fit(self, x: np.ndarray) -> "KMeans":
+        x = jnp.asarray(np.asarray(x, np.float32))
+        centroids = jnp.asarray(self._init_pp(np.asarray(x)))
+        prev = jnp.inf
+        for _ in range(self.max_iter):
+            centroids, assign, inertia = _lloyd_step(x, centroids, self.k)
+            if abs(float(prev) - float(inertia)) < self.tol:
+                break
+            prev = inertia
+        self.centroids = np.asarray(centroids)
+        self.labels_ = np.asarray(assign)
+        self.inertia = float(inertia)
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        d2 = ((np.asarray(x)[:, None, :] - self.centroids[None]) ** 2).sum(-1)
+        return d2.argmin(1)

@@ -1,0 +1,4 @@
+"""Visualization: t-SNE (exact, jitted) + Barnes-Hut t-SNE, weight/activation
+plotting, render endpoint."""
+
+from deeplearning4j_tpu.plot.tsne import Tsne  # noqa: F401

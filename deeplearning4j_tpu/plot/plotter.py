@@ -1,0 +1,138 @@
+"""Weight/activation plotting + filter rendering.
+
+≙ reference plot/NeuralNetPlotter.java:34 (which shells out to bundled
+matplotlib scripts — resources/scripts/plot.py) and FilterRenderer.java.
+Python is idiomatic here already, so matplotlib is called directly
+(headless Agg backend).  ≙ NeuralNetPlotterIterationListener hooks this
+into the optimizer loop.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.api import IterationListener
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+class NeuralNetPlotter:
+    def __init__(self, out_dir: str | Path = "plots"):
+        self.out_dir = Path(out_dir)
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+
+    def plot_weight_histograms(self, params: dict, name: str = "weights") -> Path:
+        """Histogram grid of every param tensor (≙ plotWeights/plot.py)."""
+        plt = _plt()
+        items = list(params.items())
+        cols = min(len(items), 3)
+        rows_n = math.ceil(len(items) / cols)
+        fig, axes = plt.subplots(rows_n, cols, figsize=(4 * cols, 3 * rows_n), squeeze=False)
+        for ax in axes.flat:
+            ax.axis("off")
+        for ax, (key, w) in zip(axes.flat, items):
+            ax.axis("on")
+            ax.hist(np.asarray(w).ravel(), bins=50)
+            ax.set_title(key)
+        out = self.out_dir / f"{name}.png"
+        fig.tight_layout()
+        fig.savefig(out)
+        plt.close(fig)
+        return out
+
+    def render_filters(
+        self, w: np.ndarray, name: str = "filters", patch_shape: tuple[int, int] | None = None
+    ) -> Path:
+        """Grid image of learned filters (≙ FilterRenderer.java:541).
+
+        w: (n_in, n_out) dense weights (columns become patches) or
+        (kh, kw, c_in, c_out) conv kernels.
+        """
+        plt = _plt()
+        w = np.asarray(w)
+        if w.ndim == 4:
+            patches = [w[:, :, 0, i] for i in range(w.shape[-1])]
+        else:
+            side = patch_shape or (
+                int(math.isqrt(w.shape[0])), int(math.isqrt(w.shape[0]))
+            )
+            patches = [w[: side[0] * side[1], i].reshape(side) for i in range(w.shape[1])]
+        n = len(patches)
+        cols = math.ceil(math.sqrt(n))
+        rows_n = math.ceil(n / cols)
+        fig, axes = plt.subplots(rows_n, cols, figsize=(cols, rows_n), squeeze=False)
+        for ax in axes.flat:
+            ax.axis("off")
+        for ax, p in zip(axes.flat, patches):
+            ax.imshow(p, cmap="gray")
+        out = self.out_dir / f"{name}.png"
+        fig.tight_layout()
+        fig.savefig(out, dpi=120)
+        plt.close(fig)
+        return out
+
+    def plot_activations(self, activations: np.ndarray, name: str = "activations") -> Path:
+        plt = _plt()
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.imshow(np.asarray(activations), aspect="auto", cmap="viridis")
+        ax.set_xlabel("unit")
+        ax.set_ylabel("example")
+        out = self.out_dir / f"{name}.png"
+        fig.savefig(out)
+        plt.close(fig)
+        return out
+
+
+class PlotterIterationListener(IterationListener):
+    """≙ NeuralNetPlotterIterationListener.java:70 — render every N iters."""
+
+    def __init__(self, get_params, out_dir="plots", every: int = 50):
+        self.get_params = get_params
+        self.plotter = NeuralNetPlotter(out_dir)
+        self.every = every
+
+    def iteration_done(self, info: dict) -> None:
+        i = info["iteration"]
+        if i % self.every == 0:
+            self.plotter.plot_weight_histograms(
+                self.get_params(), name=f"weights_iter{i}"
+            )
+
+
+def serve_tsne(words: list[str], coords: np.ndarray, port: int = 0) -> int:
+    """Tiny render endpoint serving t-SNE coords as JSON
+    (≙ plot/dropwizard RenderApplication.java:53 + ApiResource.java:65)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    payload = json.dumps(
+        [
+            {"word": w, "x": float(x), "y": float(y)}
+            for w, (x, y) in zip(words, np.asarray(coords))
+        ]
+    ).encode()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server.server_address[1]

@@ -1,0 +1,122 @@
+"""t-SNE.
+
+≙ reference plot/Tsne.java:261 (gains + momentum gradient loop, exact
+pairwise affinities) and plot/BarnesHutTsne.java:333 (quadtree
+approximation for large N).
+
+TPU re-design: the exact O(N^2) variant is the accelerator fast path —
+the pairwise-distance and affinity computations are dense matmuls that
+map straight onto the MXU, and the whole gradient loop (gains, momentum,
+re-centering) runs as one ``lax.fori_loop`` inside jit.  P-matrix
+construction (perplexity binary search) happens once, host-side.
+The Barnes-Hut variant (host, quadtree) is in
+:mod:`deeplearning4j_tpu.plot.barnes_hut`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _hbeta(d_row: np.ndarray, beta: float) -> tuple[float, np.ndarray]:
+    p = np.exp(-d_row * beta)
+    s = p.sum() + 1e-12
+    h = np.log(s) + beta * (d_row * p).sum() / s
+    return h, p / s
+
+
+def p_affinities(x: np.ndarray, perplexity: float = 30.0, tol: float = 1e-5) -> np.ndarray:
+    """Conditional -> joint affinities with per-point beta binary search
+    (≙ Tsne's x2p)."""
+    n = x.shape[0]
+    d2 = np.square(x[:, None, :] - x[None, :, :]).sum(-1)
+    p = np.zeros((n, n))
+    log_u = np.log(perplexity)
+    for i in range(n):
+        idx = np.arange(n) != i
+        beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+        for _ in range(50):
+            h, row = _hbeta(d2[i, idx], beta)
+            if abs(h - log_u) < tol:
+                break
+            if h > log_u:
+                beta_min = beta
+                beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+            else:
+                beta_max = beta
+                beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+        p[i, idx] = row
+    p = (p + p.T) / (2 * n)
+    return np.maximum(p, 1e-12)
+
+
+@functools.partial(jax.jit, static_argnames=("n_iter", "stop_lying_iter"))
+def _tsne_loop(p, y0, lr, momentum_0, momentum_f, n_iter, stop_lying_iter):
+    n = y0.shape[0]
+    p_lied = p * 4.0  # early exaggeration (≙ Tsne's lie factor)
+
+    def body(i, carry):
+        y, y_inc, gains = carry
+        pm = jnp.where(i < stop_lying_iter, p_lied, p)
+        d2 = jnp.sum((y[:, None, :] - y[None, :, :]) ** 2, axis=-1)
+        num = 1.0 / (1.0 + d2)
+        num = num * (1.0 - jnp.eye(n))
+        q = jnp.maximum(num / jnp.sum(num), 1e-12)
+        pq = (pm - q) * num  # (N, N)
+        grad = 4.0 * (jnp.diag(pq.sum(1)) - pq) @ y
+        momentum = jnp.where(i < 20, momentum_0, momentum_f)
+        same_sign = jnp.sign(grad) == jnp.sign(y_inc)
+        gains = jnp.maximum(
+            jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01
+        )
+        y_inc = momentum * y_inc - lr * gains * grad
+        y = y + y_inc
+        y = y - jnp.mean(y, axis=0, keepdims=True)
+        return (y, y_inc, gains)
+
+    y, _, _ = jax.lax.fori_loop(
+        0, n_iter, body, (y0, jnp.zeros_like(y0), jnp.ones_like(y0))
+    )
+    return y
+
+
+class Tsne:
+    """≙ Tsne.Builder: perplexity, learningRate, maxIter, momentum switch."""
+
+    def __init__(
+        self,
+        n_components: int = 2,
+        perplexity: float = 30.0,
+        learning_rate: float = 200.0,
+        n_iter: int = 500,
+        initial_momentum: float = 0.5,
+        final_momentum: float = 0.8,
+        stop_lying_iter: int = 100,
+        seed: int = 0,
+    ):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.stop_lying_iter = stop_lying_iter
+        self.seed = seed
+
+    def calculate(self, x: np.ndarray) -> np.ndarray:
+        """(N, D) -> (N, n_components) embedding (≙ Tsne.calculate:261)."""
+        x = np.asarray(x, dtype=np.float32)
+        p = jnp.asarray(p_affinities(x, self.perplexity), jnp.float32)
+        key = jax.random.key(self.seed)
+        y0 = 1e-4 * jax.random.normal(key, (x.shape[0], self.n_components))
+        y = _tsne_loop(
+            p, y0, self.learning_rate, self.initial_momentum,
+            self.final_momentum, self.n_iter, self.stop_lying_iter,
+        )
+        return np.asarray(y)
+
+    fit_transform = calculate

@@ -1,0 +1,112 @@
+"""Viz + clustering tests ≙ reference TsneTest, BarnesHutTsneTest,
+KDTreeTest, QuadTreeTest, VpTreeNodeTest, KMeans behavior."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeans, QuadTree, VPTree
+from deeplearning4j_tpu.plot.barnes_hut import BarnesHutTsne
+from deeplearning4j_tpu.plot.plotter import NeuralNetPlotter, serve_tsne
+from deeplearning4j_tpu.plot.tsne import Tsne
+
+
+def _three_blobs(n_per=30, seed=0, d=10):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, (3, d))
+    pts = np.concatenate([c + rng.normal(0, 0.3, (n_per, d)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts.astype(np.float32), labels
+
+
+def _cluster_quality(y, labels):
+    # mean intra-cluster dist / mean inter-cluster dist (lower is better)
+    intra, inter = [], []
+    for i in range(len(y)):
+        for j in range(i + 1, len(y)):
+            d = np.linalg.norm(y[i] - y[j])
+            (intra if labels[i] == labels[j] else inter).append(d)
+    return np.mean(intra) / np.mean(inter)
+
+
+def test_tsne_separates_blobs():
+    x, labels = _three_blobs()
+    y = Tsne(perplexity=15.0, n_iter=300, seed=1).calculate(x)
+    assert y.shape == (90, 2)
+    assert np.isfinite(y).all()
+    assert _cluster_quality(y, labels) < 0.5
+
+
+def test_barnes_hut_tsne_separates_blobs():
+    x, labels = _three_blobs(n_per=20)
+    y = BarnesHutTsne(perplexity=10.0, n_iter=150, seed=1).fit_transform(x)
+    assert y.shape == (60, 2)
+    assert np.isfinite(y).all()
+    assert _cluster_quality(y, labels) < 0.6
+
+
+def test_kmeans_recovers_blobs():
+    x, labels = _three_blobs()
+    km = KMeans(k=3, seed=2).fit(x)
+    assert km.centroids.shape == (3, x.shape[1])
+    # purity: majority label per cluster
+    purity = 0
+    for c in range(3):
+        members = labels[km.labels_ == c]
+        if len(members):
+            purity += np.bincount(members).max()
+    assert purity / len(labels) > 0.95
+
+
+def test_kdtree_knn_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=(200, 4))
+    tree = KDTree(pts)
+    q = rng.normal(size=4)
+    got = [i for _, i in tree.nearest(q, k=5)]
+    want = np.argsort(np.linalg.norm(pts - q, axis=1))[:5].tolist()
+    assert got == want
+    # range query
+    hits = tree.range(np.full(4, -0.5), np.full(4, 0.5))
+    brute = [i for i, p in enumerate(pts) if np.all(p >= -0.5) and np.all(p <= 0.5)]
+    assert sorted(hits) == sorted(brute)
+
+
+def test_vptree_knn_matches_bruteforce():
+    rng = np.random.default_rng(4)
+    pts = rng.normal(size=(150, 6))
+    tree = VPTree(pts)
+    q = rng.normal(size=6)
+    got = [i for _, i in tree.nearest(q, k=4)]
+    want = np.argsort(np.linalg.norm(pts - q, axis=1))[:4].tolist()
+    assert got == want
+
+
+def test_quadtree_mass_and_forces():
+    rng = np.random.default_rng(5)
+    pts = rng.normal(size=(50, 2))
+    tree = QuadTree.build(pts)
+    assert tree.mass == 50
+    assert np.allclose(tree.com, pts.mean(0), atol=1e-9)
+    f = np.zeros(2)
+    s = tree.compute_non_edge_forces(pts[0], theta=0.5, neg_f=f)
+    assert np.isfinite(f).all() and s > 0
+
+
+def test_plotter_outputs_files(tmp_path):
+    p = NeuralNetPlotter(tmp_path)
+    rng = np.random.default_rng(0)
+    out1 = p.plot_weight_histograms({"W": rng.normal(size=(20, 10)), "b": rng.normal(size=10)})
+    out2 = p.render_filters(rng.normal(size=(49, 9)))
+    out3 = p.plot_activations(rng.random((16, 32)))
+    for f in (out1, out2, out3):
+        assert f.exists() and f.stat().st_size > 0
+
+
+def test_tsne_render_endpoint():
+    import json
+    import urllib.request
+
+    port = serve_tsne(["a", "b"], np.array([[0.0, 1.0], [2.0, 3.0]]))
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/coords") as r:
+        data = json.loads(r.read())
+    assert data[0]["word"] == "a" and data[1]["x"] == 2.0
