@@ -1,0 +1,203 @@
+"""Recursive Neural Tensor Network (Socher) over binarized trees.
+
+≙ reference models/rntn/RNTN.java:55-1392: composition
+``h = f(W [l; r; 1] + [l; r]^T V [l; r])`` bottom-up over a binary tree,
+per-node softmax sentiment classification, AdaGrad training, RNTNEval.
+
+TPU re-design: the reference fits trees through actor futures
+(RNTN.fit:341) with per-label ``MultiDimensionalMap`` parameter maps; here
+a single shared (W, V, Wc, embeddings) parameter set (the common Socher
+formulation — per-label maps collapse to one because binarized trees have
+one composition type) and the whole per-tree forward+backward is one
+jitted autodiff program over a *level-packed* representation: tree nodes
+are topologically ordered so composition is a ``lax.scan`` over a node
+table instead of Python recursion.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tree import Tree
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+def topo_pack(tree: Tree, cache: VocabCache, num_classes: int):
+    """Pack a binary tree into arrays for scan execution.
+
+    Returns (word_ids, left, right, is_leaf, labels) over nodes in
+    topological (children-first) order.  Leaf nodes reference embedding
+    rows; internal nodes reference child slots.
+    """
+    nodes: list[Tree] = []
+
+    def visit(t: Tree):
+        for c in t.children:
+            visit(c)
+        nodes.append(t)
+
+    visit(tree)
+    n = len(nodes)
+    index = {id(t): i for i, t in enumerate(nodes)}
+    word_ids = np.zeros(n, np.int32)
+    left = np.zeros(n, np.int32)
+    right = np.zeros(n, np.int32)
+    leaf = np.zeros(n, np.float32)
+    labels = np.zeros(n, np.int32)
+    for i, t in enumerate(nodes):
+        try:
+            labels[i] = int(t.label.lstrip("@")) % num_classes
+        except ValueError:
+            labels[i] = 0
+        if t.is_leaf():
+            leaf[i] = 1.0
+            word_ids[i] = max(cache.index_of(t.word or ""), 0)
+        elif len(t.children) == 1:
+            leaf[i] = 0.0
+            left[i] = right[i] = index[id(t.children[0])]
+        else:
+            left[i] = index[id(t.children[0])]
+            right[i] = index[id(t.children[1])]
+    return word_ids, left, right, leaf, labels
+
+
+class RNTN:
+    def __init__(
+        self,
+        num_classes: int = 2,
+        dim: int = 16,
+        lr: float = 0.05,
+        use_tensor: bool = True,
+        seed: int = 123,
+        max_nodes: int = 64,
+    ):
+        self.num_classes = num_classes
+        self.dim = dim
+        self.lr = lr
+        self.use_tensor = use_tensor
+        self.seed = seed
+        self.max_nodes = max_nodes
+        self.cache = VocabCache()
+        self.params = None
+        self._adagrad = None
+
+    def init_params(self) -> None:
+        d, c, v = self.dim, self.num_classes, max(len(self.cache), 1)
+        k = jax.random.split(jax.random.key(self.seed), 4)
+        r = 1.0 / np.sqrt(2 * d)
+        self.params = {
+            "W": jax.random.uniform(k[0], (d, 2 * d + 1), minval=-r, maxval=r),
+            "V": jax.random.uniform(k[1], (2 * d, 2 * d, d), minval=-r, maxval=r)
+            * (1.0 if self.use_tensor else 0.0),
+            "Wc": jax.random.uniform(k[2], (c, d + 1), minval=-r, maxval=r),
+            "emb": 0.1 * jax.random.normal(k[3], (v, d)),
+        }
+        self._adagrad = jax.tree.map(jnp.zeros_like, self.params)
+
+    # -- forward over the packed tree (scan) -------------------------------
+    def _tree_loss(self, params, word_ids, left, right, leaf, labels, node_mask):
+        d = self.dim
+        n = word_ids.shape[0]
+        vecs0 = jnp.zeros((n, d))
+
+        def body(i, vecs):
+            l = vecs[left[i]]
+            r_vec = vecs[right[i]]
+            lr_cat = jnp.concatenate([l, r_vec, jnp.ones(1)])
+            linear = params["W"] @ lr_cat
+            lr2 = jnp.concatenate([l, r_vec])
+            tensor = jnp.einsum("a,abd,b->d", lr2, params["V"], lr2)
+            composed = jnp.tanh(linear + tensor)
+            leaf_vec = jnp.tanh(params["emb"][word_ids[i]])
+            vec = jnp.where(leaf[i] > 0, leaf_vec, composed)
+            return vecs.at[i].set(vec)
+
+        vecs = jax.lax.fori_loop(0, n, body, vecs0)
+        logits = vecs @ params["Wc"][:, :d].T + params["Wc"][:, d]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -logp[jnp.arange(n), labels] * node_mask
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(node_mask), 1.0), vecs
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def _step(self, params, hist, word_ids, left, right, leaf, labels, node_mask, lr):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: self._tree_loss(p, word_ids, left, right, leaf, labels, node_mask),
+            has_aux=True,
+        )(params)
+        hist = jax.tree.map(lambda h, g: h + g * g, hist, grads)
+        params = jax.tree.map(
+            lambda p, g, h: p - lr * g / (jnp.sqrt(h) + 1e-8), params, grads, hist
+        )
+        return params, hist, loss
+
+    def _pad(self, arrs):
+        """Pad packed tree arrays to max_nodes (one compiled step shape)."""
+        word_ids, left, right, leaf, labels = arrs
+        n = len(word_ids)
+        m = self.max_nodes
+        if n > m:
+            raise ValueError(f"tree has {n} nodes > max_nodes={m}")
+        pad = m - n
+        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        out = [np.concatenate([a, np.zeros(pad, a.dtype)]) for a in (word_ids, left, right)]
+        leaf_p = np.concatenate([leaf, np.ones(pad, np.float32)])  # pads act as leaves
+        labels_p = np.concatenate([labels, np.zeros(pad, np.int32)])
+        return (*out, leaf_p, labels_p, mask)
+
+    def fit_trees(self, trees: Iterable[Tree], epochs: int = 1) -> list[float]:
+        """≙ RNTN.fit:341 (actor-parallel loop -> sequential jitted steps)."""
+        trees = list(trees)
+        if len(self.cache) == 0:
+            self.cache.fit([t.words() for t in trees])
+        if self.params is None:
+            self.init_params()
+        losses = []
+        for _ in range(epochs):
+            total = 0.0
+            for t in trees:
+                packed = self._pad(topo_pack(t, self.cache, self.num_classes))
+                args = [jnp.asarray(a) for a in packed]
+                self.params, self._adagrad, loss = self._step(
+                    self.params, self._adagrad, *args, jnp.float32(self.lr)
+                )
+                total += float(loss)
+            losses.append(total / max(len(trees), 1))
+        return losses
+
+    def predict_root(self, tree: Tree) -> int:
+        packed = self._pad(topo_pack(tree, self.cache, self.num_classes))
+        word_ids, left, right, leaf, labels, mask = (jnp.asarray(a) for a in packed)
+        _, vecs = self._tree_loss(
+            self.params, word_ids, left, right, leaf, labels, mask
+        )
+        n_real = int(mask.sum())
+        root_vec = vecs[n_real - 1]
+        d = self.dim
+        logits = self.params["Wc"][:, :d] @ root_vec + self.params["Wc"][:, d]
+        return int(jnp.argmax(logits))
+
+
+class RNTNEval:
+    """≙ RNTNEval.java:61 — accuracy over tree root labels."""
+
+    def __init__(self):
+        self.correct = 0
+        self.total = 0
+
+    def eval(self, model: RNTN, trees: Iterable[Tree]) -> None:
+        for t in trees:
+            try:
+                gold = int(t.label) % model.num_classes
+            except ValueError:
+                continue
+            self.total += 1
+            if model.predict_root(t) == gold:
+                self.correct += 1
+
+    def accuracy(self) -> float:
+        return self.correct / self.total if self.total else 0.0

@@ -1,0 +1,58 @@
+"""Viterbi sequence decoding.
+
+≙ reference util/Viterbi.java:176 (pure-Java decoder; the vendored
+CRFSuite binaries were dead resources — SURVEY §2).  Implemented as a
+jittable ``lax.scan`` over log-domain transition/emission scores.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def viterbi_decode(log_emissions: jax.Array, log_transitions: jax.Array, log_start: jax.Array):
+    """Most likely state path.
+
+    log_emissions: (T, S); log_transitions: (S, S) [from, to]; log_start: (S,)
+    Returns (path (T,), score).
+    """
+    t0 = log_start + log_emissions[0]
+
+    def step(delta, emit):
+        scores = delta[:, None] + log_transitions  # (S_from, S_to)
+        best_prev = jnp.argmax(scores, axis=0)
+        delta_next = jnp.max(scores, axis=0) + emit
+        return delta_next, best_prev
+
+    delta, backptrs = jax.lax.scan(step, t0, log_emissions[1:])
+    last = jnp.argmax(delta)
+    score = delta[last]
+
+    def backtrack(state, ptrs):
+        prev = ptrs[state]
+        return prev, state
+
+    first, rest = jax.lax.scan(backtrack, last, backptrs, reverse=True)
+    path = jnp.concatenate([jnp.array([first]), rest])
+    return path, score
+
+
+class Viterbi:
+    """Stateful wrapper with probability-space inputs (≙ util/Viterbi.java)."""
+
+    def __init__(self, transitions: np.ndarray, start: np.ndarray | None = None):
+        self.log_transitions = jnp.log(jnp.asarray(transitions) + 1e-12)
+        s = transitions.shape[0]
+        start = start if start is not None else np.full(s, 1.0 / s)
+        self.log_start = jnp.log(jnp.asarray(start) + 1e-12)
+
+    def decode(self, emissions: np.ndarray) -> tuple[np.ndarray, float]:
+        path, score = viterbi_decode(
+            jnp.log(jnp.asarray(emissions) + 1e-12),
+            self.log_transitions,
+            self.log_start,
+        )
+        return np.asarray(path), float(score)

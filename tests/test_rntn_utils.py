@@ -1,0 +1,140 @@
+"""RNTN + tree pipeline + utility tests."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.rntn import RNTN, RNTNEval, topo_pack
+from deeplearning4j_tpu.nlp.tree import (
+    Tree,
+    TreeVectorizer,
+    binarize,
+    collapse_unaries,
+    parse_ptb,
+    right_branching_tree,
+)
+from deeplearning4j_tpu.utils.counters import Counter, CounterMap
+from deeplearning4j_tpu.utils.dedup import StringGrid, fingerprint
+from deeplearning4j_tpu.utils.disk_queue import DiskBasedQueue
+from deeplearning4j_tpu.utils import math_utils as mu
+from deeplearning4j_tpu.utils.viterbi import Viterbi
+
+
+def test_ptb_parse_roundtrip():
+    s = "(3 (2 good) (1 (0 not) (2 bad)))"
+    t = parse_ptb(s)
+    assert t.label == "3"
+    assert t.words() == ["good", "not", "bad"]
+    assert str(t) == "(3 (2 good) (1 (0 not) (2 bad)))"
+
+
+def test_binarize_and_collapse():
+    t = parse_ptb("(S (A a) (B b) (C c) (D d))")
+    b = binarize(t)
+    for node in b.subtrees():
+        assert len(node.children) <= 2
+    assert b.words() == ["a", "b", "c", "d"]
+
+    u = parse_ptb("(S (X (Y (A a))) (B b))")
+    c = collapse_unaries(u)
+    assert c.words() == ["a", "b"]
+    assert c.depth() <= u.depth()
+
+
+def test_right_branching_and_vectorizer():
+    t = right_branching_tree(["a", "b", "c"])
+    assert t.words() == ["a", "b", "c"]
+    for node in t.subtrees():
+        assert len(node.children) in (0, 2)
+    trees = TreeVectorizer().trees("One two three. Four five.")
+    assert len(trees) == 2
+
+
+def test_topo_pack_children_before_parents():
+    t = parse_ptb("(1 (0 a) (1 (0 b) (1 c)))")
+    from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+    cache = VocabCache().fit([t.words()])
+    word_ids, left, right, leaf, labels = topo_pack(t, cache, 2)
+    n = len(word_ids)
+    for i in range(n):
+        if leaf[i] == 0:
+            assert left[i] < i and right[i] < i
+
+
+def test_rntn_learns_sentiment():
+    """Tiny sentiment task: label 1 trees contain 'good', label 0 'bad'."""
+    rng = np.random.default_rng(0)
+    pos_words = ["good", "great", "fine", "nice"]
+    neg_words = ["bad", "awful", "poor", "sad"]
+    fill = ["movie", "film", "plot", "was", "the"]
+    trees = []
+    for _ in range(60):
+        pos = rng.random() < 0.5
+        words = list(rng.choice(pos_words if pos else neg_words, 2)) + list(
+            rng.choice(fill, 2)
+        )
+        rng.shuffle(words)
+        t = binarize(right_branching_tree(words, label="1" if pos else "0"))
+        for node in t.subtrees():
+            node.label = t.label
+        trees.append(t)
+    model = RNTN(num_classes=2, dim=8, lr=0.1, seed=1, max_nodes=16)
+    losses = model.fit_trees(trees, epochs=6)
+    assert losses[-1] < losses[0]
+    ev = RNTNEval()
+    ev.eval(model, trees)
+    assert ev.accuracy() > 0.85, ev.accuracy()
+
+
+def test_viterbi_decodes_obvious_path():
+    # two states; state 0 emits obs 0, state 1 emits obs 1
+    trans = np.array([[0.8, 0.2], [0.2, 0.8]])
+    emissions_for = lambda obs: np.array([[0.9, 0.1] if o == 0 else [0.1, 0.9] for o in obs])
+    v = Viterbi(trans)
+    path, score = v.decode(emissions_for([0, 0, 1, 1, 0]))
+    assert path.tolist() == [0, 0, 1, 1, 0]
+    assert score < 0
+
+
+def test_counters():
+    c = Counter(["a", "b", "a"])
+    assert c.get_count("a") == 2
+    assert c.arg_max() == "a"
+    c.normalize()
+    assert abs(c.total_count() - 1.0) < 1e-9
+
+    cm = CounterMap()
+    cm.increment_count("x", "y", 2.0)
+    cm.increment_count("x", "z")
+    assert cm.get_count("x", "y") == 2.0
+    assert cm.get_counter("x").arg_max() == "y"
+
+
+def test_math_utils():
+    assert mu.entropy([0.5, 0.5]) == pytest.approx(1.0)
+    assert mu.entropy([1.0]) == 0.0
+    assert mu.log_sum_exp([0.0, 0.0]) == pytest.approx(np.log(2))
+    assert mu.cosine_similarity([1, 0], [1, 0]) == pytest.approx(1.0)
+    assert mu.correlation([1, 2, 3], [2, 4, 6]) == pytest.approx(1.0)
+    assert mu.next_power_of_2(17) == 32
+    assert mu.information_gain([0.5, 0.5], [(0.5, [1.0]), (0.5, [1.0])]) == pytest.approx(1.0)
+
+
+def test_fingerprint_dedup():
+    assert fingerprint("Héllo,  World!") == fingerprint("world hello")
+    grid = StringGrid([["Tom Cruise", "1"], ["cruise, tom", "2"], ["Other", "3"]])
+    clusters = grid.clusters_by_fingerprint(0)
+    assert any(len(v) == 2 for v in clusters.values())
+    assert len(grid.dedup_column(0).rows) == 2
+
+
+def test_disk_queue(tmp_path):
+    q = DiskBasedQueue(tmp_path / "q")
+    assert q.is_empty()
+    q.add({"a": 1})
+    q.add([1, 2])
+    assert len(q) == 2
+    assert q.peek() == {"a": 1}
+    assert q.poll() == {"a": 1}
+    assert q.poll() == [1, 2]
+    assert q.poll() is None
