@@ -1,0 +1,148 @@
+"""Command-line entry point.
+
+≙ reference CLI layer (SURVEY §1-L8): DeepLearning4jDistributedApp
+(args4j master/worker flags, DeepLearning4jDistributedApp.java:60), YARN
+Client, shell launchers.  In the SPMD world every host runs the same
+program, so "master/worker" collapses into ``--process-id``/``--coordinator``
+for ``jax.distributed`` plus the shared training command.
+
+Usage:
+  python -m deeplearning4j_tpu train --model lenet --epochs 2
+  python -m deeplearning4j_tpu train --coordinator host:8476 --num-processes 4 --process-id 1
+  python -m deeplearning4j_tpu bench
+  python -m deeplearning4j_tpu status --port 9090
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_distributed_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--coordinator", default=None, help="host:port of process 0")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+
+
+def cmd_train(args) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.coordinator:
+        from deeplearning4j_tpu.parallel.cluster import initialize_distributed
+
+        initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+
+    from deeplearning4j_tpu.datasets import fetchers
+    from deeplearning4j_tpu.parallel import DataParallelTrainer, data_parallel_mesh
+    from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.parallel.cluster import ClusterService
+
+    if args.model == "lenet":
+        from deeplearning4j_tpu.models.lenet import build_lenet, lenet_loss
+
+        net, params = build_lenet()
+        loss_fn = lenet_loss(net)
+        ds = fetchers.mnist(n=args.examples)
+    elif args.model == "alexnet":
+        from deeplearning4j_tpu.models.alexnet import build_alexnet, synthetic_cifar
+        from deeplearning4j_tpu.models.lenet import lenet_loss
+
+        net, params = build_alexnet()
+        loss_fn = lenet_loss(net)
+        ds = synthetic_cifar(args.examples)
+    else:
+        print(f"unknown model {args.model}", file=sys.stderr)
+        return 2
+
+    svc = ClusterService()
+    if args.status_port is not None:
+        port = svc.start_rest_api(args.status_port)
+        print(f"status REST on http://127.0.0.1:{port}/statetracker")
+    mesh = data_parallel_mesh()
+    trainer = DataParallelTrainer(loss_fn, mesh=mesh)
+    state = trainer.init(params)
+    mgr = CheckpointManager(args.checkpoint_dir, save_every=args.save_every) if args.checkpoint_dir else None
+
+    svc.phase = "train"
+    n = ds.num_examples()
+    b = min(args.batch, n)
+    step_idx = 0
+    for epoch in range(args.epochs):
+        for batch in ds.batches(b, drop_last=True):
+            x, y = trainer.shard_batch(jnp.asarray(batch.features), jnp.asarray(batch.labels))
+            state, loss = trainer.step(state, x, y, jax.random.key(step_idx))
+            step_idx += 1
+            svc.batches_so_far = step_idx
+            if step_idx % 10 == 0:
+                print(f"epoch {epoch} step {step_idx} loss {float(loss):.4f}")
+            if svc.report_loss(float(loss)):
+                print("early stop triggered")
+                break
+            if mgr:
+                mgr.maybe_save(step_idx, state.params, {"loss": float(loss)})
+    svc.phase = "done"
+    print(f"final loss {float(loss):.4f}")
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import bench
+
+    bench.main()
+    return 0
+
+
+def cmd_status(args) -> int:
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://127.0.0.1:{args.port}/statetracker") as r:
+        print(json.dumps(json.loads(r.read()), indent=2))
+    return 0
+
+
+def cmd_provision(args) -> int:
+    from deeplearning4j_tpu.utils.cloud_io import render_tpu_vm_provision
+
+    print(" ".join(render_tpu_vm_provision(args.name, args.accelerator_type, args.zone)))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="deeplearning4j_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a model (single or multi-host SPMD)")
+    t.add_argument("--model", default="lenet", choices=["lenet", "alexnet"])
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--batch", type=int, default=256)
+    t.add_argument("--examples", type=int, default=4096)
+    t.add_argument("--checkpoint-dir", default=None)
+    t.add_argument("--save-every", type=int, default=50)
+    t.add_argument("--status-port", type=int, default=None)
+    _add_distributed_flags(t)
+    t.set_defaults(fn=cmd_train)
+
+    b = sub.add_parser("bench", help="run the benchmark harness")
+    b.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser("status", help="query a running trainer's REST status")
+    s.add_argument("--port", type=int, required=True)
+    s.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("provision", help="render TPU-VM provisioning command")
+    p.add_argument("name")
+    p.add_argument("--accelerator-type", default="v5litepod-8")
+    p.add_argument("--zone", default="us-central1-a")
+    p.set_defaults(fn=cmd_provision)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,97 @@
+"""Character-level LSTM language model (char-RNN).
+
+≙ the reference's LSTM usage (models/classifiers/lstm/LSTM.java — a
+Karpathy-style char model with beam-search decoding) and the
+GravesLSTM char-RNN config in BASELINE.json configs[3].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn import layers as L
+
+
+class CharLSTM:
+    def __init__(self, seq_len: int = 32, lr: float = 0.1, seed: int = 0):
+        self.seq_len = seq_len
+        self.lr = lr
+        self.seed = seed
+        self.chars: list[str] = []
+        self.char_to_ix: dict[str, int] = {}
+        self.mod = L.get("lstm")
+        self.conf: C.LayerConfig | None = None
+        self.params = None
+
+    def build_vocab(self, text: str) -> None:
+        self.chars = sorted(set(text))
+        self.char_to_ix = {c: i for i, c in enumerate(self.chars)}
+        v = len(self.chars)
+        self.conf = C.LayerConfig(layer_type="lstm", n_in=v, n_out=v, activation="tanh")
+        self.params = self.mod.init(jax.random.key(self.seed), self.conf)
+
+    def _encode(self, text: str) -> np.ndarray:
+        return np.array([self.char_to_ix[c] for c in text], np.int32)
+
+    def _batches(self, ids: np.ndarray, batch: int):
+        v = len(self.chars)
+        t = self.seq_len
+        usable = (len(ids) - 1) // t * t
+        xs = ids[:usable].reshape(-1, t)
+        ys = ids[1 : usable + 1].reshape(-1, t)
+        for s in range(0, len(xs) - batch + 1, batch):
+            x = np.eye(v, dtype=np.float32)[xs[s : s + batch]]
+            y = np.eye(v, dtype=np.float32)[ys[s : s + batch]]
+            yield jnp.asarray(x), jnp.asarray(y)
+
+    def fit(self, text: str, epochs: int = 5, batch: int = 16) -> list[float]:
+        if not self.chars:
+            self.build_vocab(text)
+        ids = self._encode(text)
+        mod, conf = self.mod, self.conf
+
+        @jax.jit
+        def step(params, x, y):
+            loss, grads = jax.value_and_grad(
+                lambda p: mod.supervised_score(p, conf, x, y)
+            )(params)
+            params = jax.tree.map(lambda p, g: p - self.lr * g, params, grads)
+            return params, loss
+
+        losses = []
+        for _ in range(epochs):
+            total, n = 0.0, 0
+            for x, y in self._batches(ids, batch):
+                self.params, loss = step(self.params, x, y)
+                total += float(loss)
+                n += 1
+            losses.append(total / max(n, 1))
+        return losses
+
+    def sample(self, seed_char: str, length: int = 50, temperature: float = 1.0,
+               rng_seed: int = 0) -> str:
+        """Ancestral sampling one char at a time (≙ LSTM.predict:219)."""
+        v = len(self.chars)
+        eye = np.eye(v, dtype=np.float32)
+        h = jnp.zeros((self.conf.n_in,))
+        c = jnp.zeros((self.conf.n_in,))
+        tick = jax.jit(lambda x, h, c: self.mod.tick(self.params, self.conf, x, h, c))
+        ix = self.char_to_ix[seed_char]
+        out = [seed_char]
+        key = jax.random.key(rng_seed)
+        for _ in range(length):
+            y, h, c = tick(jnp.asarray(eye[ix]), h, c)
+            key, sub = jax.random.split(key)
+            ix = int(jax.random.categorical(sub, y / temperature))
+            out.append(self.chars[ix])
+        return "".join(out)
+
+    def beam_decode(self, seed_char: str, beam_size: int = 3, n_steps: int = 10):
+        emb = jnp.eye(len(self.chars))
+        return self.mod.beam_search(
+            self.params, self.conf, emb[self.char_to_ix[seed_char]], emb,
+            beam_size=beam_size, n_steps=n_steps,
+        )

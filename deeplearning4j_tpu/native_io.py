@@ -1,0 +1,155 @@
+"""ctypes bindings for the native C++ data loader.
+
+Builds ``native/libdl4jtpu_io.so`` on first use (g++ is baked into the
+image; pybind11 is not, hence the C ABI + ctypes).  Every entry point has
+a numpy fallback so the framework works without a compiler — the native
+path exists because host-side batch assembly is the part of the reference
+whose native layer (ND4J readers/DataSet assembly) still pays off on a
+TPU host: it feeds the chip without holding the GIL on the hot loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = Path(__file__).parent.parent / "native"
+_SO = _NATIVE_DIR / "libdl4jtpu_io.so"
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO.exists()
+    except Exception as e:  # compiler missing/failed -> numpy fallback
+        log.warning("native loader build failed (%s); using numpy fallback", e)
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not _SO.exists() and not _build():
+        return None
+    lib = ctypes.CDLL(str(_SO))
+    lib.read_idx.restype = ctypes.c_int
+    lib.read_idx.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.free_buffer.argtypes = [ctypes.c_void_p]
+    lib.u8_to_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+    ]
+    lib.shuffle_indices.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_uint64,
+    ]
+    lib.assemble_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def read_idx(path: str | Path) -> np.ndarray:
+    """Native idx reader (uint8 payloads); numpy fallback otherwise."""
+    lib = get_lib()
+    if lib is None:
+        from deeplearning4j_tpu.datasets.fetchers import _read_idx
+
+        return _read_idx(Path(path))
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    dims = (ctypes.c_int64 * 8)()
+    ndim = ctypes.c_int()
+    total = ctypes.c_int64()
+    rc = lib.read_idx(str(path).encode(), ctypes.byref(out), dims, ctypes.byref(ndim), ctypes.byref(total))
+    if rc != 0:
+        raise IOError(f"native read_idx({path}) failed rc={rc}")
+    try:
+        shape = tuple(dims[i] for i in range(ndim.value))
+        arr = np.ctypeslib.as_array(out, shape=(total.value,)).reshape(shape).copy()
+    finally:
+        lib.free_buffer(out)
+    return arr
+
+
+def shuffled_order(n: int, seed: int) -> np.ndarray:
+    lib = get_lib()
+    idx = np.arange(n, dtype=np.int64)
+    if lib is None:
+        return np.random.default_rng(seed).permutation(n)
+    lib.shuffle_indices(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, ctypes.c_uint64(seed)
+    )
+    return idx
+
+
+class NativeBatchAssembler:
+    """Shuffled float32/one-hot minibatches straight from uint8 arrays.
+
+    ≙ the fetch/assembly path of BaseDataFetcher+MnistDataFetcher, running
+    in C when the native library is present.
+    """
+
+    def __init__(self, features_u8: np.ndarray, labels_u8: np.ndarray, num_classes: int, seed: int = 0):
+        assert features_u8.dtype == np.uint8 and labels_u8.dtype == np.uint8
+        self.features = np.ascontiguousarray(features_u8.reshape(features_u8.shape[0], -1))
+        self.labels = np.ascontiguousarray(labels_u8)
+        self.num_classes = num_classes
+        self.order = shuffled_order(len(self.labels), seed)
+        self.row_len = self.features.shape[1]
+
+    def batch(self, start: int, size: int) -> tuple[np.ndarray, np.ndarray]:
+        lib = get_lib()
+        if lib is None:
+            sel = self.order[start : start + size]
+            x = self.features[sel].astype(np.float32) / 255.0
+            y = np.zeros((size, self.num_classes), np.float32)
+            y[np.arange(size), self.labels[sel]] = 1.0
+            return x, y
+        x = np.empty((size, self.row_len), np.float32)
+        y = np.empty((size, self.num_classes), np.float32)
+        lib.assemble_batch(
+            self.features.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self.labels.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self.order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            start, size, self.row_len, self.num_classes,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return x, y

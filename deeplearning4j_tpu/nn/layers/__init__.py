@@ -22,4 +22,5 @@ from deeplearning4j_tpu.nn.layers import (  # noqa: F401
     lstm,
     output,
     rbm,
+    recursive_autoencoder,
 )

@@ -1,0 +1,82 @@
+"""Recursive autoencoder over sequence prefixes.
+
+≙ reference models/featuredetectors/autoencoder/recursive/
+RecursiveAutoEncoder.java:19 — folds a sequence left-to-right, encoding
+``h_t = f(W_h [x_t; h_{t-1}] + b)`` and scoring the reconstruction of
+both inputs at every fold.  The Java per-prefix loop becomes a
+``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import activations, weights
+from deeplearning4j_tpu.nn.conf import LayerConfig
+from deeplearning4j_tpu.nn.layers import api
+from deeplearning4j_tpu.nn.layers.api import Params
+
+
+@api.register("recursive_autoencoder")
+class RecursiveAutoEncoder:
+    """conf.n_in = feature dim per step (hidden dim == n_in)."""
+
+    def init(self, key: jax.Array, conf: LayerConfig) -> Params:
+        d = conf.n_in
+        k1, k2 = jax.random.split(key)
+        dtype = dtypes.get_policy().param_dtype
+        return {
+            "W": weights.init_weights(k1, (2 * d, d), conf.weight_init, conf.dist),
+            "b": jnp.zeros((d,), dtype),
+            "Wd": weights.init_weights(k2, (d, 2 * d), conf.weight_init, conf.dist),
+            "bd": jnp.zeros((2 * d,), dtype),
+        }
+
+    def _fold(self, params: Params, conf: LayerConfig, x: jax.Array):
+        """x: (B, T, d) -> (hidden states (B, T, d), recon loss scalar)."""
+        act = activations.get(conf.activation)
+        b, t, d = x.shape
+        h0 = x[:, 0, :]
+
+        def step(h_prev, x_t):
+            cat = jnp.concatenate([x_t, h_prev], axis=-1)
+            h = act(cat @ params["W"] + params["b"])
+            recon = act(h @ params["Wd"] + params["bd"])
+            err = jnp.mean(jnp.sum((recon - cat) ** 2, axis=-1))
+            return h, (h, err)
+
+        _, (hs, errs) = lax.scan(step, h0, jnp.swapaxes(x[:, 1:, :], 0, 1))
+        hs = jnp.concatenate([h0[:, None, :], jnp.swapaxes(hs, 0, 1)], axis=1)
+        return hs, jnp.mean(errs)
+
+    def activate(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        x: jax.Array,
+        key: jax.Array | None = None,
+        training: bool = False,
+    ) -> jax.Array:
+        """Final fold state (B, d) for (B, T, d) input; for 2-D input each
+        row is treated as a length-n_in sequence of scalars? No — 2-D input
+        (B, d) passes through an identity fold (single step)."""
+        if x.ndim == 2:
+            return x
+        hs, _ = self._fold(params, conf, x)
+        return hs[:, -1, :]
+
+    def score(self, params: Params, conf: LayerConfig, x: jax.Array, key: jax.Array):
+        if x.ndim == 2:
+            # interpret a flat batch as (B, T=1) no-fold: nothing to learn
+            x = x[:, None, :]
+        _, err = self._fold(params, conf, x)
+        return err + api.l2_penalty(params, conf)
+
+    def gradient(self, params: Params, conf: LayerConfig, x: jax.Array, key: jax.Array):
+        return api.default_gradient(self, params, conf, x, key)
+
+    def pre_output(self, params: Params, conf: LayerConfig, x: jax.Array) -> jax.Array:
+        return self.activate(params, conf, x)

@@ -1,0 +1,124 @@
+"""Pluggable model/data storage backends.
+
+≙ reference ops IO: ``ModelSaver`` impls (DefaultModelSaver file, HDFS
+HdfsModelSaver.java:19, S3 S3ModelSaver) plus the S3/HDFS dataset
+iterators (BaseS3DataSetIterator, BaseHdfsDataSetIterator) and AWS
+provisioning glue (deeplearning4j-aws, SURVEY §2).
+
+Cloud SDKs are *gated*: the interface always exists, object-store
+backends activate only when their client library is importable (this
+build environment has zero egress).  EC2-style provisioning is replaced
+by a TPU-VM provisioning *command renderer* — cloud CLIs do the work, so
+the framework emits the commands rather than shelling out.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Protocol
+
+
+class ModelSaver(Protocol):
+    def save(self, blob: bytes, name: str) -> str: ...
+    def load(self, name: str) -> bytes: ...
+
+
+class LocalModelSaver:
+    """≙ DefaultModelSaver.java:19."""
+
+    def __init__(self, directory: str | Path):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def save(self, blob: bytes, name: str) -> str:
+        p = self.dir / name
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        tmp.write_bytes(blob)
+        tmp.replace(p)
+        return str(p)
+
+    def load(self, name: str) -> bytes:
+        return (self.dir / name).read_bytes()
+
+
+class S3ModelSaver:
+    """≙ S3ModelSaver (deeplearning4j-aws). Requires boto3."""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError("S3ModelSaver requires boto3") from e
+        import boto3
+
+        self.client = boto3.client("s3")
+        self.bucket = bucket
+        self.prefix = prefix.rstrip("/")
+
+    def _key(self, name: str) -> str:
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    def save(self, blob: bytes, name: str) -> str:
+        self.client.put_object(Bucket=self.bucket, Key=self._key(name), Body=blob)
+        return f"s3://{self.bucket}/{self._key(name)}"
+
+    def load(self, name: str) -> bytes:
+        return self.client.get_object(Bucket=self.bucket, Key=self._key(name))[
+            "Body"
+        ].read()
+
+
+class GCSModelSaver:
+    """GCS twin of S3ModelSaver (the TPU-native object store). Requires
+    google-cloud-storage."""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            from google.cloud import storage  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError("GCSModelSaver requires google-cloud-storage") from e
+        from google.cloud import storage
+
+        self.bucket = storage.Client().bucket(bucket)
+        self.prefix = prefix.rstrip("/")
+
+    def _key(self, name: str) -> str:
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    def save(self, blob: bytes, name: str) -> str:
+        self.bucket.blob(self._key(name)).upload_from_string(blob)
+        return f"gs://{self.bucket.name}/{self._key(name)}"
+
+    def load(self, name: str) -> bytes:
+        return self.bucket.blob(self._key(name)).download_as_bytes()
+
+
+def get_saver(uri: str) -> ModelSaver:
+    """Scheme-dispatch: s3://bucket/prefix, gs://bucket/prefix, or a path."""
+    if uri.startswith("s3://"):
+        bucket, _, prefix = uri[5:].partition("/")
+        return S3ModelSaver(bucket, prefix)
+    if uri.startswith("gs://"):
+        bucket, _, prefix = uri[5:].partition("/")
+        return GCSModelSaver(bucket, prefix)
+    return LocalModelSaver(uri)
+
+
+def render_tpu_vm_provision(
+    name: str,
+    accelerator_type: str = "v5litepod-8",
+    zone: str = "us-central1-a",
+    version: str = "tpu-ubuntu2204-base",
+    startup_script: str | None = None,
+) -> list[str]:
+    """TPU-VM provisioning commands (≙ Ec2BoxCreator/ClusterSetup.java:24
+    spinning up EC2 workers — here rendered as gcloud invocations for the
+    operator or an orchestrator to run)."""
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "create", name,
+        f"--zone={zone}", f"--accelerator-type={accelerator_type}",
+        f"--version={version}",
+    ]
+    if startup_script:
+        cmd.append(f"--metadata=startup-script={startup_script}")
+    return cmd

@@ -1,0 +1,114 @@
+// Native host-side data loader: idx parsing, batch assembly, shuffling.
+//
+// Role parity: the reference's compute-critical native layer lives behind
+// ND4J (BLAS/CUDA); on TPU the device math belongs to XLA, so the native
+// seam that still pays is the *host input pipeline* feeding the chip —
+// idx decoding, uint8->float32 conversion, shuffled minibatch gather and
+// one-hot expansion run here at memory bandwidth, off the Python heap
+// (≙ the reference's datasets/mnist binary readers + DataSet assembly,
+// MnistManager.java:130, BaseDataFetcher.fetch).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// splitmix64 — small, seedable, reproducible across platforms.
+static inline uint64_t splitmix64(uint64_t* state) {
+  uint64_t z = (*state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// Parse an idx file. Returns 0 on success. Caller frees *out with
+// free_buffer. dims must hold up to 8 entries; *ndim receives the rank.
+int read_idx(const char* path, uint8_t** out, int64_t* dims, int* ndim,
+             int64_t* total_bytes) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint8_t header[4];
+  if (fread(header, 1, 4, f) != 4 || header[0] != 0 || header[1] != 0) {
+    fclose(f);
+    return -2;
+  }
+  int dtype = header[2];
+  int rank = header[3];
+  if (rank > 8 || dtype != 0x08) {  // uint8 payloads only (MNIST family)
+    fclose(f);
+    return -3;
+  }
+  int64_t count = 1;
+  for (int i = 0; i < rank; i++) {
+    uint8_t b[4];
+    if (fread(b, 1, 4, f) != 4) {
+      fclose(f);
+      return -4;
+    }
+    dims[i] = ((int64_t)b[0] << 24) | (b[1] << 16) | (b[2] << 8) | b[3];
+    count *= dims[i];
+  }
+  uint8_t* buf = (uint8_t*)malloc(count);
+  if (!buf) {
+    fclose(f);
+    return -5;
+  }
+  if ((int64_t)fread(buf, 1, count, f) != count) {
+    free(buf);
+    fclose(f);
+    return -6;
+  }
+  fclose(f);
+  *out = buf;
+  *ndim = rank;
+  *total_bytes = count;
+  return 0;
+}
+
+void free_buffer(void* p) { free(p); }
+
+// uint8 -> float32 scaled to [0,1].
+void u8_to_f32(const uint8_t* src, float* dst, int64_t n) {
+  static float lut[256];
+  static int init = 0;
+  if (!init) {
+    for (int i = 0; i < 256; i++) lut[i] = (float)i / 255.0f;
+    init = 1;
+  }
+  for (int64_t i = 0; i < n; i++) dst[i] = lut[src[i]];
+}
+
+// In-place Fisher-Yates shuffle of an index array.
+void shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
+  uint64_t st = seed;
+  for (int64_t i = n - 1; i > 0; i--) {
+    int64_t j = (int64_t)(splitmix64(&st) % (uint64_t)(i + 1));
+    int64_t tmp = idx[i];
+    idx[i] = idx[j];
+    idx[j] = tmp;
+  }
+}
+
+// Assemble one shuffled minibatch: gather `batch` rows of u8 features
+// (row_len each) into float32 [0,1] and labels into one-hot float32.
+void assemble_batch(const uint8_t* features, const uint8_t* labels,
+                    const int64_t* order, int64_t start, int64_t batch,
+                    int64_t row_len, int num_classes, float* out_x,
+                    float* out_y) {
+  for (int64_t b = 0; b < batch; b++) {
+    int64_t src = order[start + b];
+    const uint8_t* row = features + src * row_len;
+    float* dst = out_x + b * row_len;
+    u8_to_f32(row, dst, row_len);
+    float* yrow = out_y + b * num_classes;
+    memset(yrow, 0, sizeof(float) * num_classes);
+    int lbl = labels[src];
+    if (lbl >= 0 && lbl < num_classes) yrow[lbl] = 1.0f;
+  }
+}
+
+}  // extern "C"

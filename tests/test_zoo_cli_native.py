@@ -1,0 +1,116 @@
+"""Model zoo (char-LSTM, AlexNet, recursive AE), CLI, cloud IO, native loader."""
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native_io
+from deeplearning4j_tpu.models.alexnet import build_alexnet, synthetic_cifar
+from deeplearning4j_tpu.models.char_lstm import CharLSTM
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.utils.cloud_io import LocalModelSaver, get_saver, render_tpu_vm_provision
+
+
+def test_recursive_autoencoder_layer():
+    mod = L.get("recursive_autoencoder")
+    cfg = C.LayerConfig(layer_type="recursive_autoencoder", n_in=6, n_out=6, activation="tanh")
+    p = mod.init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (4, 5, 6))
+    h = mod.activate(p, cfg, x)
+    assert h.shape == (4, 6)
+    s0 = float(mod.score(p, cfg, x, jax.random.key(2)))
+    step = jax.jit(
+        lambda p, k: jax.tree.map(
+            lambda a, g: a - 0.05 * g, p, mod.gradient(p, cfg, x, k)[1]
+        )
+    )
+    for i in range(100):
+        p = step(p, jax.random.key(i))
+    s1 = float(mod.score(p, cfg, x, jax.random.key(3)))
+    assert s1 < s0
+
+
+def test_char_lstm_learns_and_samples():
+    text = "hello world " * 40
+    m = CharLSTM(seq_len=12, lr=1.0, seed=0)
+    losses = m.fit(text, epochs=25, batch=8)
+    assert losses[-1] < losses[0] * 0.3, losses
+    out = m.sample("h", length=20, rng_seed=1)
+    assert len(out) == 21
+    assert set(out) <= set(text)
+    beams = m.beam_decode("h", beam_size=2, n_steps=4)
+    assert beams and all(lp <= 0 for _, lp in beams)
+
+
+def test_alexnet_forward_and_one_step():
+    net, params = build_alexnet(seed=0)
+    ds = synthetic_cifar(16)
+    out = net.output(ds.features[:4])
+    assert out.shape == (4, 10)
+    from deeplearning4j_tpu.models.lenet import lenet_loss
+
+    loss_fn = lenet_loss(net)
+    l0 = float(loss_fn(params, jnp.asarray(ds.features), jnp.asarray(ds.labels)))
+    assert np.isfinite(l0)
+
+
+def test_cli_train_and_provision(tmp_path, capsys):
+    from deeplearning4j_tpu.cli import main
+
+    rc = main(
+        [
+            "train", "--model", "lenet", "--epochs", "1", "--batch", "128",
+            "--examples", "256", "--checkpoint-dir", str(tmp_path / "ck"),
+            "--save-every", "1",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final loss" in out
+    assert list((tmp_path / "ck").glob("ckpt_*.npz"))
+
+    rc = main(["provision", "mypod", "--zone", "us-east1-d"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm create mypod" in out
+    assert "--zone=us-east1-d" in out
+
+
+def test_cloud_io_local_and_dispatch(tmp_path):
+    saver = get_saver(str(tmp_path))
+    assert isinstance(saver, LocalModelSaver)
+    path = saver.save(b"hello", "model.bin")
+    assert saver.load("model.bin") == b"hello"
+    assert path.endswith("model.bin")
+    cmd = render_tpu_vm_provision("x")
+    assert cmd[0] == "gcloud"
+
+
+def test_native_loader_builds_and_matches_numpy(tmp_path):
+    if not native_io.available():
+        pytest.skip("no g++ toolchain; numpy fallback covered elsewhere")
+    rng = np.random.default_rng(0)
+    feats = rng.integers(0, 256, (50, 12), dtype=np.uint8)
+    labels = rng.integers(0, 4, 50, dtype=np.uint8)
+
+    # idx round-trip through the native reader
+    p = tmp_path / "f-idx2-ubyte"
+    with open(p, "wb") as fh:
+        fh.write(struct.pack(">HBB", 0, 0x08, 2))
+        fh.write(struct.pack(">II", 50, 12))
+        fh.write(feats.tobytes())
+    arr = native_io.read_idx(p)
+    assert (arr == feats).all()
+
+    asm = native_io.NativeBatchAssembler(feats, labels, num_classes=4, seed=7)
+    x, y = asm.batch(0, 8)
+    sel = asm.order[:8]
+    assert np.allclose(x, feats[sel].astype(np.float32) / 255.0)
+    assert (y.argmax(1) == labels[sel]).all()
+    # deterministic shuffle for a fixed seed
+    asm2 = native_io.NativeBatchAssembler(feats, labels, num_classes=4, seed=7)
+    assert (asm.order == asm2.order).all()
