@@ -1,0 +1,1 @@
+"""Core ops: attention (+ ring/sequence-parallel variants), pallas kernels."""

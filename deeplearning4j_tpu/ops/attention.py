@@ -1,0 +1,81 @@
+"""Scaled-dot-product attention + online-softmax blocked variant.
+
+The reference predates attention entirely (its only sequence model walks
+LSTM timesteps in a Java loop — SURVEY §5 'long-context: entirely
+absent'), but long-context support is first-class in this framework: this
+module provides the numerically-stable online-softmax formulation that
+both the ring-attention sequence-parallel path
+(:mod:`deeplearning4j_tpu.parallel.sequence_parallel`) and the pallas
+flash kernel build on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False) -> jax.Array:
+    """Reference dense attention. q,k,v: (B, T, H, D) -> (B, T, H, D)."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        t_q, t_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def online_softmax_block(q, k_blk, v_blk, m_prev, l_prev, o_prev, block_bias=None):
+    """One KV-block update of streaming (flash-style) attention.
+
+    q: (B, Tq, H, D); k_blk/v_blk: (B, Tb, H, D);
+    m_prev/l_prev: (B, H, Tq) running max / normalizer; o_prev: (B, Tq, H, D).
+    Returns updated (m, l, o).  Combining all KV blocks in any order
+    reproduces exact softmax attention — the invariant ring attention
+    relies on.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) / jnp.sqrt(d).astype(q.dtype)
+    if block_bias is not None:
+        s = s + block_bias
+    m_blk = jnp.max(s, axis=-1)  # (B, H, Tq)
+    m_new = jnp.maximum(m_prev, m_blk)
+    # guard -inf - -inf when a fully-masked block arrives
+    safe = lambda x, m: jnp.where(jnp.isneginf(m)[..., None], 0.0, jnp.exp(x - m[..., None]))
+    p = safe(s, m_new)  # (B, H, Tq, Tk)
+    correction = jnp.where(
+        jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - jnp.where(jnp.isneginf(m_new), 0.0, m_new))
+    )
+    l_new = correction * l_prev + jnp.sum(p, axis=-1)
+    o_new = correction.transpose(0, 2, 1)[..., None] * o_prev + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_blk
+    )
+    return m_new, l_new, o_new
+
+
+def finalize_online_softmax(l, o):
+    """Divide accumulated numerator by the normalizer."""
+    return o / jnp.maximum(l.transpose(0, 2, 1)[..., None], 1e-30)
+
+
+def blocked_attention(q, k, v, block_size: int, causal: bool = False) -> jax.Array:
+    """Single-device streaming attention over KV blocks (validates the
+    online-softmax math that ring attention distributes)."""
+    b, t, h, d = q.shape
+    m = jnp.full((b, h, t), -jnp.inf, q.dtype)
+    l = jnp.zeros((b, h, t), q.dtype)
+    o = jnp.zeros_like(q)
+    pos_q = jnp.arange(t)
+    for start in range(0, t, block_size):
+        k_blk = k[:, start : start + block_size]
+        v_blk = v[:, start : start + block_size]
+        bias = None
+        if causal:
+            pos_k = start + jnp.arange(k_blk.shape[1])
+            bias = jnp.where(pos_q[:, None] >= pos_k[None, :], 0.0, -jnp.inf)[
+                None, None, :, :
+            ]
+        m, l, o = online_softmax_block(q, k_blk, v_blk, m, l, o, bias)
+    return finalize_online_softmax(l, o)
