@@ -1,0 +1,131 @@
+"""Pallas TPU kernels for hot ops.
+
+Two kernels, each with an ``interpret=True`` path so tests run on CPU and
+the lowered path engages on real TPU:
+
+- ``flash_attention``: blocked attention forward keeping the running
+  softmax state in VMEM scratch — one HBM pass over K/V per Q block.
+  The online-softmax math matches ``ops.attention.blocked_attention``.
+- ``fused_embedding_dot``: the Word2Vec HS inner product batch
+  (gather rows -> masked sigmoid dots) fused into one VMEM-resident
+  kernel — the hot read side of InMemoryLookupTable.iterateSample.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is optional at import time (CPU test envs)
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# -- flash attention ----------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int, scale: float):
+    q = q_ref[0]  # (block_q, d)
+    m = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
+    l = jnp.zeros((q.shape[0],), jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+
+    def body(start, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :]
+        v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1)
+        acc_new = corr[:, None] * acc + jnp.dot(
+            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, kv_len // block_k, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """(B, T, H, D) attention, pallas-blocked. T must divide by blocks."""
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0
+    interpret = (not _on_tpu()) if interpret is None else interpret
+    scale = 1.0 / (d**0.5)
+
+    # fold batch and heads into the grid; Q tiled over rows
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, kv_len=t, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+# -- fused embedding dot (word2vec HS read side) ------------------------------
+
+def _emb_dot_kernel(h_ref, w_ref, mask_ref, out_ref):
+    h = h_ref[:]  # (block_b, d)
+    w = w_ref[:]  # (block_b, L, d)
+    mask = mask_ref[:]  # (block_b, L)
+    dots = jnp.einsum("bd,bld->bl", h, w)
+    out_ref[:] = jax.nn.sigmoid(jnp.clip(dots, -6.0, 6.0)) * mask
+
+
+def fused_embedding_dot(
+    h: jax.Array, w_rows: jax.Array, mask: jax.Array, block_b: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """sigmoid(<h_b, w_{b,l}>) * mask — (B, D), (B, L, D), (B, L) -> (B, L)."""
+    b, d = h.shape
+    L = w_rows.shape[1]
+    block_b = min(block_b, b)
+    assert b % block_b == 0
+    interpret = (not _on_tpu()) if interpret is None else interpret
+    return pl.pallas_call(
+        _emb_dot_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, L), h.dtype),
+        grid=(b // block_b,),
+        in_specs=[
+            pl.BlockSpec((block_b, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, L, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((block_b, L), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, L), lambda i: (i, 0)),
+        interpret=interpret,
+    )(h, w_rows, mask)
