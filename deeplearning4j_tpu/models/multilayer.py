@@ -97,7 +97,14 @@ class MultiLayerNetwork:
         )
         for i in range(n):
             c = self.conf.confs[i]
-            h = _adapt_input(acts[-1], c.layer_type, c.n_in if c.layer_type == "conv_downsample" else 0)
+            h = acts[-1]
+            if i in self.conf.preprocessors:
+                from deeplearning4j_tpu.nn import preprocessors as pp
+
+                h = pp.get(self.conf.preprocessors[i])(
+                    h, subkeys[i] if training else None
+                )
+            h = _adapt_input(h, c.layer_type, c.n_in if c.layer_type == "conv_downsample" else 0)
             acts.append(
                 self.modules[i].activate(params[i], c, h, key=subkeys[i], training=training)
             )

@@ -168,6 +168,9 @@ class MultiLayerConfig:
     damping_factor: float = 10.0  # Hessian-free initial damping
     use_gauss_newton_vector_product_back_prop: bool = False
     use_drop_connect: bool = False
+    # per-layer-index input processors (≙ OutputPreProcessor wiring);
+    # names resolved against nn.preprocessors
+    preprocessors: dict[int, str] = field(default_factory=dict)
 
     def conf(self, i: int) -> LayerConfig:
         return self.confs[i]
@@ -186,6 +189,7 @@ class MultiLayerConfig:
             "damping_factor": self.damping_factor,
             "use_gauss_newton_vector_product_back_prop": self.use_gauss_newton_vector_product_back_prop,
             "use_drop_connect": self.use_drop_connect,
+            "preprocessors": {str(k): v for k, v in self.preprocessors.items()},
         }
 
     @classmethod
@@ -193,6 +197,8 @@ class MultiLayerConfig:
         d = dict(d)
         d["confs"] = [LayerConfig.from_dict(c) for c in d.get("confs", [])]
         d["hidden_layer_sizes"] = tuple(d.get("hidden_layer_sizes", ()))
+        if "preprocessors" in d and d["preprocessors"] is not None:
+            d["preprocessors"] = {int(k): v for k, v in d["preprocessors"].items()}
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in known})
 

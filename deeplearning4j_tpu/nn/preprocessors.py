@@ -1,0 +1,68 @@
+"""Inter-layer activation pre/post processors.
+
+≙ reference nn/conf/preprocessor (ReshapePreProcessor,
+BinomialSamplingPreProcessor, ZeroMeanAndUnitVariancePreProcessor,
+UnitVarianceProcessor) and the conv reshape pair
+(nn/layers/convolution/preprocessor/*.java) — transforms applied to a
+layer's input activations, configured per layer index on
+``MultiLayerConfig.preprocessors``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Processor = Callable[[jax.Array, jax.Array | None], jax.Array]
+
+_REGISTRY: dict[str, Processor] = {}
+
+
+def register(name: str):
+    def deco(fn: Processor) -> Processor:
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get(name: str) -> Processor:
+    if name.startswith("reshape:"):
+        dims = tuple(int(x) for x in name.split(":", 1)[1].split(","))
+        return lambda x, key=None: x.reshape((x.shape[0], *dims))
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"Unknown preprocessor {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+@register("flatten")
+def flatten(x, key=None):
+    return x.reshape(x.shape[0], -1)
+
+
+@register("binomial_sampling")
+def binomial_sampling(x, key=None):
+    """≙ BinomialSamplingPreProcessor: sample Bernoulli(x)."""
+    if key is None:
+        return x  # deterministic eval passes activations through
+    return jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
+
+
+@register("zero_mean_unit_variance")
+def zero_mean_unit_variance(x, key=None):
+    mean = jnp.mean(x, axis=0, keepdims=True)
+    std = jnp.std(x, axis=0, keepdims=True) + 1e-8
+    return (x - mean) / std
+
+
+@register("zero_mean")
+def zero_mean(x, key=None):
+    return x - jnp.mean(x, axis=0, keepdims=True)
+
+
+@register("unit_variance")
+def unit_variance(x, key=None):
+    return x / (jnp.std(x, axis=0, keepdims=True) + 1e-8)

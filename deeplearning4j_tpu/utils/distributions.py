@@ -1,0 +1,45 @@
+"""Distribution factory.
+
+≙ reference distributions/Distributions.java:109 (commons-math factory
+for normal/uniform/binomial used by weight init and sampling).  Names map
+to functional ``jax.random`` samplers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Sampler = Callable[..., jax.Array]
+
+
+def normal(mean: float = 0.0, std: float = 1.0) -> Sampler:
+    def sample(key, shape, dtype=jnp.float32):
+        return mean + std * jax.random.normal(key, shape, dtype)
+
+    return sample
+
+
+def uniform(low: float = 0.0, high: float = 1.0) -> Sampler:
+    def sample(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=low, maxval=high)
+
+    return sample
+
+
+def binomial(n: int = 1, p: float = 0.5) -> Sampler:
+    def sample(key, shape, dtype=jnp.float32):
+        if n == 1:
+            return jax.random.bernoulli(key, p, shape).astype(dtype)
+        return jax.random.binomial(key, n, p, shape).astype(dtype)
+
+    return sample
+
+
+def get(name: str, *args, **kw) -> Sampler:
+    try:
+        return {"normal": normal, "uniform": uniform, "binomial": binomial}[name](*args, **kw)
+    except KeyError:
+        raise ValueError(f"Unknown distribution {name!r}") from None

@@ -1,0 +1,76 @@
+"""Tracing / profiling.
+
+The reference has no structured tracing — only StopWatch logging in the
+YARN worker (WorkerNode.java:39-75) and per-iteration score logs
+(BaseOptimizer.java:160); SURVEY §5 prescribes a first-class profiler
+module for the TPU build.  This wraps the JAX profiler (XPlane/Perfetto
+traces viewable in TensorBoard/Perfetto) plus a host-side StopWatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from pathlib import Path
+
+
+class StopWatch:
+    """≙ commons StopWatch usage in WorkerNode: wall-clock segments."""
+
+    def __init__(self):
+        self._start: float | None = None
+        self.total = 0.0
+        self.laps: list[float] = []
+
+    def start(self) -> "StopWatch":
+        self._start = time.perf_counter()
+        return self
+
+    def stop(self) -> float:
+        assert self._start is not None, "not started"
+        lap = time.perf_counter() - self._start
+        self.laps.append(lap)
+        self.total += lap
+        self._start = None
+        return lap
+
+    @contextlib.contextmanager
+    def lap(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path = "/tmp/dl4j_tpu_trace"):
+    """Capture an XPlane/Perfetto trace around a code region."""
+    import jax
+
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up in device traces."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def timed(label: str, sink=None):
+    """Host-side timing context; sink(label, seconds) or print."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if sink:
+            sink(label, dt)
+        else:
+            print(f"[timing] {label}: {dt:.4f}s")

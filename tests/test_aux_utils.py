@@ -1,0 +1,125 @@
+"""Aux subsystem tests: preprocessors, distributions, profiling, metrics,
+collections, sentiment."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn import preprocessors as pp
+from deeplearning4j_tpu.nlp.sentiment import SentiWordNet
+from deeplearning4j_tpu.utils import distributions as dist
+from deeplearning4j_tpu.utils.collections_util import (
+    MultiDimensionalMap,
+    SummaryStatistics,
+    extract_archive,
+)
+from deeplearning4j_tpu.utils.metrics import MetricsIterationListener, MetricsWriter
+from deeplearning4j_tpu.utils.profiling import StopWatch, timed
+
+
+def test_preprocessors():
+    x = jnp.arange(12.0).reshape(2, 6)
+    assert pp.get("reshape:2,3")(x).shape == (2, 2, 3)
+    assert pp.get("flatten")(pp.get("reshape:2,3")(x)).shape == (2, 6)
+    z = pp.get("zero_mean_unit_variance")(x)
+    assert jnp.allclose(z.mean(0), 0.0, atol=1e-5)
+    probs = jnp.full((4, 3), 0.5)
+    s = pp.get("binomial_sampling")(probs, jax.random.key(0))
+    assert set(np.unique(np.asarray(s))) <= {0.0, 1.0}
+    # deterministic eval pass-through
+    assert jnp.allclose(pp.get("binomial_sampling")(probs, None), probs)
+
+
+def test_preprocessors_in_network():
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+
+    mc = C.list_builder(
+        C.LayerConfig(activation="tanh"), sizes=[4], n_in=6, n_out=2,
+        pretrain=False, backward=True,
+    )
+    mc.preprocessors = {0: "zero_mean_unit_variance"}
+    mc2 = C.MultiLayerConfig.from_json(mc.to_json())
+    assert mc2.preprocessors == {0: "zero_mean_unit_variance"}
+    net = MultiLayerNetwork(mc, seed=0)
+    net.init()
+    out = net.output(np.random.default_rng(0).normal(2.0, 3.0, (8, 6)).astype(np.float32))
+    assert out.shape == (8, 2)
+
+
+def test_distributions():
+    key = jax.random.key(0)
+    n = dist.get("normal", 1.0, 0.5)(key, (2000,))
+    assert abs(float(n.mean()) - 1.0) < 0.05
+    u = dist.get("uniform", -2, 2)(key, (1000,))
+    assert float(u.min()) >= -2 and float(u.max()) <= 2
+    b = dist.get("binomial", 1, 0.3)(key, (3000,))
+    assert abs(float(b.mean()) - 0.3) < 0.05
+
+
+def test_stopwatch_and_timed():
+    sw = StopWatch()
+    with sw.lap():
+        sum(range(1000))
+    assert sw.total > 0 and len(sw.laps) == 1
+    records = []
+    with timed("x", sink=lambda label, dt: records.append((label, dt))):
+        pass
+    assert records and records[0][0] == "x"
+
+
+def test_metrics_writer_and_listener(tmp_path):
+    w = MetricsWriter(tmp_path / "m.jsonl")
+    listener = MetricsIterationListener(w)
+    for i in range(3):
+        listener.iteration_done({"iteration": i, "score": 1.0 / (i + 1)})
+    w.close()
+    recs = MetricsWriter.read(tmp_path / "m.jsonl")
+    scores = [r for r in recs if r["tag"] == "train/score"]
+    assert len(scores) == 3 and scores[-1]["value"] == pytest.approx(1 / 3)
+
+
+def test_collections_util(tmp_path):
+    m = MultiDimensionalMap()
+    m.put("a", 1, "x")
+    assert m.get("a", 1) == "x" and m.contains("a", 1) and len(m) == 1
+
+    s = SummaryStatistics()
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        s.add(v)
+    assert s.mean == pytest.approx(2.5)
+    assert s.std == pytest.approx(np.std([1, 2, 3, 4], ddof=1))
+    assert s.min == 1.0 and s.max == 4.0
+
+    import tarfile
+
+    archive = tmp_path / "a.tar.gz"
+    (tmp_path / "payload.txt").write_text("hi")
+    with tarfile.open(archive, "w:gz") as t:
+        t.add(tmp_path / "payload.txt", arcname="payload.txt")
+    out = extract_archive(archive, tmp_path / "out")
+    assert (out / "payload.txt").read_text() == "hi"
+
+
+def test_sentiment_scoring():
+    s = SentiWordNet()
+    assert s.score("a great wonderful movie") > 0.5
+    assert s.score("an awful terrible film") < -0.5
+    assert s.verdict("this was great and amazing") in ("positive", "strong_positive")
+    assert s.verdict("the plot was awful") in ("negative", "strong_negative")
+    assert s.verdict("the chair is wooden") == "neutral"
+    # negation flips polarity
+    assert s.score("not good") < 0
+
+
+def test_sentiwordnet_file_loader(tmp_path):
+    f = tmp_path / "swn.txt"
+    f.write_text(
+        "# comment\n"
+        "a\t1\t0.75\t0\tgood#1 fine#2\tgloss\n"
+        "a\t2\t0\t0.875\tbad#1\tgloss\n"
+    )
+    s = SentiWordNet.from_sentiwordnet_file(f)
+    assert s.lexicon["good"] == pytest.approx(0.75)
+    assert s.lexicon["bad"] == pytest.approx(-0.875)
