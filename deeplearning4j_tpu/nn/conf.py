@@ -100,6 +100,7 @@ class LayerConfig:
     sparsity: float = 0.0
     apply_sparsity: bool = False
     dropout: float = 0.0
+    use_drop_connect: bool = False  # mask weights instead of activations
     corruption_level: float = 0.3
 
     # RBM

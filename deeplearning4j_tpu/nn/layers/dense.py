@@ -44,7 +44,14 @@ class DenseLayer:
         key: jax.Array | None = None,
         training: bool = False,
     ) -> jax.Array:
-        x = api.apply_dropout(x, conf, key, training)
+        if conf.use_drop_connect and training and conf.dropout > 0 and key is not None:
+            # DropConnect (≙ MultiLayerConfiguration.useDropConnect): mask
+            # weights rather than activations
+            mask = api.dropout_mask(key, params[WEIGHT_KEY].shape, conf.dropout,
+                                    params[WEIGHT_KEY].dtype)
+            params = {**params, WEIGHT_KEY: params[WEIGHT_KEY] * mask}
+        else:
+            x = api.apply_dropout(x, conf, key, training)
         return activations.get(conf.activation)(self.pre_output(params, conf, x))
 
     def transpose(self, params: Params) -> Params:

@@ -1,0 +1,153 @@
+"""Numeric gradient checks + determinism tests — test classes the
+reference entirely lacks (SURVEY §4: 'no gradient-check tests, no
+determinism/seed tests')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import fetchers
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import conf as C
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.utils import tree_math as tm
+
+
+def _numeric_grad(f, params, eps=1e-2):
+    # central differences under float32: eps must sit where truncation
+    # O(eps^2) and roundoff O(ulp/eps) are both small — ~1e-2 is the sweet
+    # spot for unit-scale params/gradients
+    """Central-difference gradient of scalar f over a param pytree."""
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    flat = np.asarray(flat, np.float64)
+    g = np.zeros_like(flat)
+    for i in range(len(flat)):
+        up, down = flat.copy(), flat.copy()
+        up[i] += eps
+        down[i] -= eps
+        g[i] = (float(f(unravel(jnp.asarray(up, jnp.float32))))
+                - float(f(unravel(jnp.asarray(down, jnp.float32))))) / (2 * eps)
+    return g
+
+
+@pytest.mark.parametrize("activation", ["tanh", "sigmoid", "relu"])
+def test_dense_output_gradcheck(activation):
+    mod = L.get("output")
+    cfg = C.LayerConfig(layer_type="output", n_in=3, n_out=2,
+                        activation="softmax", loss="MCXENT")
+    hidden_cfg = C.LayerConfig(n_in=4, n_out=3, activation=activation)
+    hmod = L.get("dense")
+    k = jax.random.key(0)
+    hp = hmod.init(k, hidden_cfg)
+    op = mod.init(jax.random.key(1), cfg)
+    x = jax.random.normal(jax.random.key(2), (5, 4))
+    y = jax.nn.one_hot(jnp.array([0, 1, 0, 1, 1]), 2)
+    params = {"h": hp, "o": op}
+
+    def f(p):
+        hidden = hmod.activate(p["h"], hidden_cfg, x)
+        return mod.supervised_score(p["o"], cfg, hidden, y)
+
+    analytic, _ = jax.flatten_util.ravel_pytree(jax.grad(f)(params))
+    numeric = _numeric_grad(f, params)
+    denom = np.maximum(np.abs(numeric) + np.abs(np.asarray(analytic)), 1e-3)
+    rel = np.abs(np.asarray(analytic) - numeric) / denom
+    assert rel.max() < 2e-2, rel.max()
+
+
+def test_lstm_bptt_gradcheck():
+    mod = L.get("lstm")
+    v = 4
+    cfg = C.LayerConfig(layer_type="lstm", n_in=v, n_out=v, activation="tanh")
+    p = mod.init(jax.random.key(0), cfg)
+    x = jax.nn.one_hot(jnp.array([[0, 1, 2, 3, 1]]), v)
+    y = jax.nn.one_hot(jnp.array([[1, 2, 3, 1, 0]]), v)
+
+    def f(p):
+        return mod.supervised_score(p, cfg, x, y)
+
+    analytic, _ = jax.flatten_util.ravel_pytree(jax.grad(f)(p))
+    numeric = _numeric_grad(f, p)
+    denom = np.maximum(np.abs(numeric) + np.abs(np.asarray(analytic)), 1e-3)
+    rel = np.abs(np.asarray(analytic) - numeric) / denom
+    assert rel.max() < 2e-2, rel.max()
+
+
+def test_conv_gradcheck_small():
+    mod = L.get("conv_downsample")
+    cfg = C.LayerConfig(layer_type="conv_downsample", n_in=1, num_feature_maps=2,
+                        filter_size=(3, 3), stride=(2, 2), activation="tanh")
+    p = mod.init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 8, 1))
+
+    def f(p):
+        # conv + smooth activation; max-pool is piecewise linear and its
+        # argmax flips break central differences at usable eps
+        return jnp.sum(jnp.tanh(mod.conv(p, cfg, x)) ** 2)
+
+    analytic, _ = jax.flatten_util.ravel_pytree(jax.grad(f)(p))
+    numeric = _numeric_grad(f, p)
+    denom = np.maximum(np.abs(numeric) + np.abs(np.asarray(analytic)), 1e-2)
+    rel = np.abs(np.asarray(analytic) - numeric) / denom
+    assert rel.max() < 3e-2, rel.max()
+
+
+def test_training_is_deterministic_by_seed():
+    ds = fetchers.iris().normalize_zero_mean_unit_variance()
+    train, _ = ds.split_test_and_train(110)
+
+    def run():
+        mc = C.list_builder(
+            C.LayerConfig(activation="tanh", num_iterations=30), sizes=[5],
+            n_in=4, n_out=3, pretrain=False, backward=True,
+        )
+        net = MultiLayerNetwork(mc, seed=99)
+        net.init()
+        net.fit_dataset(train)
+        return net.params_vector()
+
+    assert np.array_equal(run(), run())
+
+
+def test_dropconnect_masks_weights():
+    mod = L.get("dense")
+    cfg = C.LayerConfig(n_in=6, n_out=4, dropout=0.5, use_drop_connect=True,
+                        activation="linear")
+    p = mod.init(jax.random.key(0), cfg)
+    x = jnp.ones((3, 6))
+    eval_out = mod.activate(p, cfg, x)
+    train1 = mod.activate(p, cfg, x, key=jax.random.key(1), training=True)
+    train2 = mod.activate(p, cfg, x, key=jax.random.key(2), training=True)
+    assert not jnp.allclose(train1, eval_out)
+    assert not jnp.allclose(train1, train2)
+
+
+def test_spark_style_local_sgd_iris(devices):
+    """End-to-end parameter-averaged MLP on Iris over the 8-device mesh
+    ≙ TestSparkMultiLayer.java:182 (local[8] param averaging)."""
+    from deeplearning4j_tpu.evaluation import Evaluation
+    from deeplearning4j_tpu.parallel import data_parallel_mesh, local_sgd_step
+
+    ds = fetchers.iris().normalize_zero_mean_unit_variance()
+    train, test = ds.split_test_and_train(104)  # 104 divides by 8
+    mc = C.list_builder(
+        C.LayerConfig(activation="tanh"), sizes=[8], n_in=4, n_out=3,
+        pretrain=False, backward=True,
+    )
+    net = MultiLayerNetwork(mc, seed=11)
+    params = net.init()
+
+    def loss(p, x, y, key=None):
+        return net.supervised_score_fn(p, x, y)
+
+    mesh = data_parallel_mesh(8)
+    step = local_sgd_step(loss, mesh, local_steps=5, lr=0.3)
+    x = jnp.asarray(train.features)
+    y = jnp.asarray(train.labels)
+    for i in range(40):
+        params, l = step(params, x, y, jax.random.key(i))
+    net.params = list(params)
+    ev = Evaluation(3)
+    ev.eval(test.labels, np.asarray(net.output(test.features)))
+    assert ev.f1() > 0.85, ev.stats()
