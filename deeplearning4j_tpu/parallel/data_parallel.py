@@ -59,7 +59,12 @@ class DataParallelTrainer:
         mesh=None,
         optimizer: optax.GradientTransformation | None = None,
         donate: bool = True,
+        remat: bool = False,
     ):
+        if remat:
+            # rematerialize the forward in backward — trades FLOPs for HBM
+            # (jax.checkpoint), the standard big-model memory lever
+            loss_fn = jax.checkpoint(loss_fn)
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else mesh_lib.data_parallel_mesh()
         self.optimizer = optimizer or optax.sgd(1e-2, momentum=0.9)
