@@ -1,0 +1,167 @@
+"""Bucket-backed DataSet iteration.
+
+≙ reference deeplearning4j-aws ``BucketIterator`` (iterate S3 objects),
+``BaseS3DataSetIterator`` (each object -> one DataSet) and the HDFS twin
+``BaseHdfsDataSetIterator`` (hadoop-yarn/deeplearning4j-hadoop) — the
+cloud-storage leg of the data pipeline (SURVEY §2, aws module).
+
+TPU re-design: one ``BucketClient`` protocol (list/get/put) with local-dir,
+S3 and GCS implementations; DataSets travel as npz blobs.  The local
+implementation doubles as the zero-egress test double, the role the
+reference's fake-cluster fixtures play (SURVEY §4.3).
+"""
+
+from __future__ import annotations
+
+import io
+from pathlib import Path
+from typing import Iterator, Protocol
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.base import DataSet
+
+
+class BucketClient(Protocol):
+    def list_keys(self) -> list[str]: ...
+    def get(self, key: str) -> bytes: ...
+    def put(self, key: str, blob: bytes) -> None: ...
+
+
+class LocalBucketClient:
+    """Directory-as-bucket; the test double for the S3/GCS clients."""
+
+    def __init__(self, directory: str | Path):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def list_keys(self) -> list[str]:
+        return sorted(p.name for p in self.dir.iterdir() if p.is_file())
+
+    def get(self, key: str) -> bytes:
+        return (self.dir / key).read_bytes()
+
+    def put(self, key: str, blob: bytes) -> None:
+        (self.dir / key).write_bytes(blob)
+
+
+class S3BucketClient:
+    """≙ BucketIterator over an S3 bucket. Requires boto3 (gated)."""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            import boto3
+        except ImportError as e:  # zero-egress image: surfaced, not hidden
+            raise RuntimeError("S3BucketClient requires boto3") from e
+        self.client = boto3.client("s3")
+        self.bucket = bucket
+        self.prefix = prefix.rstrip("/")
+
+    def _key(self, name: str) -> str:
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    def list_keys(self) -> list[str]:
+        list_prefix = self.prefix + "/" if self.prefix else ""
+        pages = self.client.get_paginator("list_objects_v2").paginate(
+            Bucket=self.bucket, Prefix=list_prefix
+        )
+        out = []
+        for page in pages:
+            for obj in page.get("Contents", []):
+                out.append(obj["Key"][len(list_prefix) :])
+        return sorted(out)
+
+    def get(self, key: str) -> bytes:
+        return self.client.get_object(Bucket=self.bucket, Key=self._key(key))[
+            "Body"
+        ].read()
+
+    def put(self, key: str, blob: bytes) -> None:
+        self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=blob)
+
+
+class GCSBucketClient:
+    """GCS twin of S3BucketClient (the TPU-native object store).
+    Requires google-cloud-storage (gated)."""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            from google.cloud import storage
+        except ImportError as e:
+            raise RuntimeError("GCSBucketClient requires google-cloud-storage") from e
+        self.bucket = storage.Client().bucket(bucket)
+        self.prefix = prefix.rstrip("/")
+
+    def _key(self, name: str) -> str:
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    def list_keys(self) -> list[str]:
+        list_prefix = self.prefix + "/" if self.prefix else ""
+        return sorted(
+            b.name[len(list_prefix) :]
+            for b in self.bucket.list_blobs(prefix=list_prefix)
+        )
+
+    def get(self, key: str) -> bytes:
+        return self.bucket.blob(self._key(key)).download_as_bytes()
+
+    def put(self, key: str, blob: bytes) -> None:
+        self.bucket.blob(self._key(key)).upload_from_string(blob)
+
+
+def dataset_to_bytes(ds: DataSet) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, features=ds.features, labels=ds.labels)
+    return buf.getvalue()
+
+
+def dataset_from_bytes(blob: bytes) -> DataSet:
+    with np.load(io.BytesIO(blob)) as z:
+        return DataSet(z["features"], z["labels"])
+
+
+class CloudDataSetIterator:
+    """Iterates DataSets stored one-per-object in a bucket
+    (≙ BaseS3DataSetIterator).  ``preprocessor`` hook matches the local
+    iterators' DataSetPreProcessor contract."""
+
+    def __init__(self, client: BucketClient, preprocessor=None):
+        self.client = client
+        self.preprocessor = preprocessor
+        self._keys = client.list_keys()
+        self._pos = 0
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._pos >= len(self._keys):
+            raise StopIteration
+        ds = dataset_from_bytes(self.client.get(self._keys[self._pos]))
+        self._pos += 1
+        if self.preprocessor is not None:
+            ds = self.preprocessor(ds)
+        return ds
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._keys)
+
+    def next(self) -> DataSet:
+        return self.__next__()
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+def upload_dataset_shards(
+    client: BucketClient, ds: DataSet, batch_size: int, prefix: str = "part"
+) -> list[str]:
+    """Splits a DataSet into batch-sized objects (writer side of the
+    iterator; ≙ the aws module's DataSetLoader upload path)."""
+    keys = []
+    for i, batch in enumerate(ds.batches(batch_size)):
+        key = f"{prefix}-{i:05d}.npz"
+        client.put(key, dataset_to_bytes(batch))
+        keys.append(key)
+    return keys

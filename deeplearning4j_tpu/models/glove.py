@@ -48,9 +48,8 @@ def count_cooccurrences(
     return keys[:, 0], keys[:, 1], vals
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
-def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
-    """One batched AdaGrad WLS step.
+def _glove_math(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
+    """One batched AdaGrad WLS step (pure math, reused by the sharded path).
 
     w/wc: word and context embeddings (V, D); b/bc biases (V,);
     h*: AdaGrad accumulators.  loss = f(X) * (w_i.wc_j + b_i + bc_j - logX)^2
@@ -72,6 +71,11 @@ def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
     bc = bc.at[cols].add(-lr * fdiff / jnp.sqrt(hbc[cols] + 1e-8))
     loss = 0.5 * jnp.mean(fx * diff**2)
     return w, wc, b, bc, hw, hwc, hb, hbc, loss
+
+
+_glove_step = jax.jit(
+    _glove_math, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7)
+)
 
 
 class Glove:
@@ -103,7 +107,9 @@ class Glove:
         self.w = self.wc = self.b = self.bc = None
         self.loss_history: list[float] = []
 
-    def fit(self, sentences: SentenceIterator) -> None:
+    def _prepare(self, sentences: SentenceIterator):
+        """Vocab + co-occurrence counting + weight/accumulator init; returns
+        (rows, cols, logx, fx) host arrays and the AdaGrad accumulators."""
         toks = [self.tokenizer.tokens(s) for s in sentences]
         self.cache.fit(toks)
         encoded = [self.cache.encode(t) for t in toks]
@@ -118,30 +124,89 @@ class Glove:
         self.wc = (jax.random.uniform(k2, (v, d)) - 0.5) / d
         self.b = jnp.zeros((v,))
         self.bc = jnp.zeros((v,))
-        hw = jnp.ones((v, d))
-        hwc = jnp.ones((v, d))
-        hb = jnp.ones((v,))
-        hbc = jnp.ones((v,))
+        acc = (jnp.ones((v, d)), jnp.ones((v, d)), jnp.ones((v,)), jnp.ones((v,)))
 
-        logx = np.log(vals)
+        logx = np.log(vals).astype(np.float32)
         fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+        return rows, cols, logx, fx, acc
+
+    def _run_epochs(self, step, data, acc, bsz: int, reshape=None) -> None:
+        """Shared shuffle/batch/loss-history loop over the co-occurrence
+        triples; ``reshape`` folds each batch to (n_dev, per) for shard_map."""
+        rows, cols, logx, fx = data
+        hw, hwc, hb, hbc = acc
         rng = np.random.default_rng(self.seed)
         n = len(rows)
-        bsz = min(self.batch, n)
         for _ in range(self.epochs):
             order = rng.permutation(n)
             epoch_loss, nb = 0.0, 0
             for s in range(0, n - bsz + 1, bsz):
                 idx = order[s : s + bsz]
-                (self.w, self.wc, self.b, self.bc, hw, hwc, hb, hbc, loss) = _glove_step(
+                batch = [jnp.asarray(a[idx]) for a in data]
+                if reshape is not None:
+                    batch = [a.reshape(reshape) for a in batch]
+                (self.w, self.wc, self.b, self.bc, hw, hwc, hb, hbc, loss) = step(
                     self.w, self.wc, self.b, self.bc, hw, hwc, hb, hbc,
-                    jnp.asarray(rows[idx]), jnp.asarray(cols[idx]),
-                    jnp.asarray(logx[idx]), jnp.asarray(fx[idx]),
-                    jnp.float32(self.lr),
+                    *batch, jnp.float32(self.lr),
                 )
                 epoch_loss += float(loss)
                 nb += 1
             self.loss_history.append(epoch_loss / max(nb, 1))
+
+    def fit(self, sentences: SentenceIterator) -> None:
+        rows, cols, logx, fx, acc = self._prepare(sentences)
+        bsz = min(self.batch, len(rows))
+        self._run_epochs(_glove_step, (rows, cols, logx, fx), acc, bsz)
+
+    def fit_distributed(self, sentences: SentenceIterator, mesh=None) -> None:
+        """Data-parallel GloVe: each device runs the AdaGrad WLS step on its
+        shard of every co-occurrence batch from replicated tables, then the
+        updated tables (and accumulators) are averaged — the in-graph pmean
+        equivalent of the master-side table merge in the reference's
+        GloveJobAggregator (scaleout/perform/models/glove/, SURVEY §2-P8)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh or mesh_lib.data_parallel_mesh()
+        n_dev = mesh.devices.size
+        axis = mesh_lib.DATA_AXIS
+
+        rows, cols, logx, fx, acc = self._prepare(sentences)
+        hw, hwc, hb, hbc = acc
+
+        def per_device(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, logx, fx, lr):
+            out = _glove_math(
+                w, wc, b, bc, hw, hwc, hb, hbc,
+                rows[0], cols[0], logx[0], fx[0], lr,
+            )
+            *tables, loss = out
+            tables = [jax.lax.pmean(t, axis) for t in tables]
+            return (*tables, jax.lax.pmean(loss, axis))
+
+        rep, sh = P(), P(axis)
+        step = jax.jit(
+            shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(rep,) * 8 + (sh,) * 4 + (rep,),
+                out_specs=(rep,) * 9,
+                check_vma=False,
+            )
+        )
+
+        n = len(rows)
+        bsz = min(self.batch, n)
+        bsz -= bsz % n_dev
+        if bsz == 0:
+            raise ValueError(
+                f"co-occurrence batch ({min(self.batch, n)}) smaller than mesh ({n_dev})"
+            )
+        self._run_epochs(
+            step, (rows, cols, logx, fx), (hw, hwc, hb, hbc), bsz,
+            reshape=(n_dev, bsz // n_dev),
+        )
 
     # combined representation (standard GloVe: w + wc)
     @property

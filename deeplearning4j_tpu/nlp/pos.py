@@ -1,0 +1,135 @@
+"""Part-of-speech tagging.
+
+≙ reference text/annotator/PoStagger.java:263 (UIMA annotator wrapping an
+external OpenNLP maxent model) and the PoS-augmented moving-window
+featurization it feeds.  The reference ships no trainable tagger — it
+loads a binary model; here the tagger is first-class and trainable.
+
+TPU re-design: an HMM tagger — emission/transition counts accumulated
+host-side from tagged sentences, decoding via the jitted ``lax.scan``
+Viterbi (utils/viterbi.py).  Unknown words back off to a suffix lexicon.
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+
+import numpy as np
+
+from deeplearning4j_tpu.utils.viterbi import Viterbi
+
+# Minimal suffix/regex backoff, used for words unseen in training (and by
+# the untrained tagger): coarse universal-style tags.
+_SUFFIX_RULES: list[tuple[str, str]] = [
+    ("ing", "VERB"),
+    ("ed", "VERB"),
+    ("ly", "ADV"),
+    ("ous", "ADJ"),
+    ("ful", "ADJ"),
+    ("able", "ADJ"),
+    ("ible", "ADJ"),
+    ("tion", "NOUN"),
+    ("ment", "NOUN"),
+    ("ness", "NOUN"),
+    ("ity", "NOUN"),
+    ("s", "NOUN"),
+]
+_CLOSED_CLASS = {
+    "the": "DET", "a": "DET", "an": "DET", "this": "DET", "that": "DET",
+    "in": "ADP", "on": "ADP", "at": "ADP", "of": "ADP", "for": "ADP",
+    "to": "PRT", "and": "CONJ", "or": "CONJ", "but": "CONJ",
+    "he": "PRON", "she": "PRON", "it": "PRON", "they": "PRON", "we": "PRON",
+    "i": "PRON", "you": "PRON",
+    "is": "VERB", "are": "VERB", "was": "VERB", "were": "VERB", "be": "VERB",
+    "not": "ADV", "very": "ADV",
+    ".": ".", ",": ".", "!": ".", "?": ".", ";": ".", ":": ".",
+}
+
+
+def rule_tag(word: str) -> str:
+    """Lexicon + suffix backoff for a single token."""
+    w = word.lower()
+    if w in _CLOSED_CLASS:
+        return _CLOSED_CLASS[w]
+    if w and (w[0].isdigit() or w.replace(".", "", 1).isdigit()):
+        return "NUM"
+    for suffix, tag in _SUFFIX_RULES:
+        if len(w) > len(suffix) + 1 and w.endswith(suffix):
+            return tag
+    return "NOUN"
+
+
+class PosTagger:
+    """HMM tagger with add-one smoothing and rule backoff for OOV words."""
+
+    def __init__(self, smoothing: float = 1.0):
+        self.smoothing = smoothing
+        self.tags: list[str] = []
+        self._tag_index: dict[str, int] = {}
+        self._word_tag: dict[str, Counter] = defaultdict(Counter)
+        self._viterbi: Viterbi | None = None
+        self._emission_cache: dict[str, np.ndarray] = {}
+
+    @property
+    def trained(self) -> bool:
+        return self._viterbi is not None
+
+    def fit(self, tagged_sentences: list[list[tuple[str, str]]]) -> None:
+        """tagged_sentences: [[(word, tag), ...], ...]"""
+        tagset = sorted({t for sent in tagged_sentences for _, t in sent})
+        self.tags = tagset
+        self._tag_index = {t: i for i, t in enumerate(tagset)}
+        s = len(tagset)
+        trans = np.full((s, s), self.smoothing)
+        start = np.full(s, self.smoothing)
+        for sent in tagged_sentences:
+            prev = None
+            for word, tag in sent:
+                i = self._tag_index[tag]
+                self._word_tag[word.lower()][tag] += 1
+                if prev is None:
+                    start[i] += 1
+                else:
+                    trans[prev, i] += 1
+                prev = i
+        trans /= trans.sum(axis=1, keepdims=True)
+        start /= start.sum()
+        self._viterbi = Viterbi(trans, start)
+        self._emission_cache.clear()
+
+    def _emission_row(self, word: str) -> np.ndarray:
+        w = word.lower()
+        cached = self._emission_cache.get(w)
+        if cached is not None:
+            return cached
+        s = len(self.tags)
+        counts = self._word_tag.get(w)
+        if counts:
+            row = np.full(s, self.smoothing * 0.01)
+            for tag, c in counts.items():
+                row[self._tag_index[tag]] += c
+        else:  # OOV: point mass (plus floor) on the rule-backoff tag
+            row = np.full(s, 0.1)
+            t = rule_tag(word)
+            if t in self._tag_index:
+                row[self._tag_index[t]] += 1.0
+        row = row / row.sum()
+        self._emission_cache[w] = row
+        return row
+
+    def tag(self, words: list[str]) -> list[tuple[str, str]]:
+        """Most likely tag sequence for a tokenized sentence."""
+        if not words:
+            return []
+        if not self.trained:
+            return [(w, rule_tag(w)) for w in words]
+        emissions = np.stack([self._emission_row(w) for w in words])
+        path, _ = self._viterbi.decode(emissions)
+        return [(w, self.tags[int(i)]) for w, i in zip(words, path)]
+
+    def tag_sentence(self, sentence: str, tokenizer=None) -> list[tuple[str, str]]:
+        if tokenizer is None:
+            from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizer
+
+            tokenizer = DefaultTokenizer()
+        return self.tag(tokenizer.tokens(sentence))

@@ -1,0 +1,46 @@
+"""PCA dimensionality reduction.
+
+≙ ND4J ``org.nd4j.linalg.dimensionalityreduction.PCA.pca(X, ndims,
+normalize)`` — part of the reference's consumed L0 API surface (SURVEY §1-L0)
+and used by t-SNE preprocessing (reference plot/Tsne.java:262-263).
+
+TPU re-design: one jitted thin-SVD on the centered (optionally whitened)
+matrix; the projection is a single MXU matmul.  Returns host numpy to match
+the host-side analysis call sites (t-SNE input prep, user tooling).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _pca_project(x, n_dims: int, normalize: bool):
+    mean = jnp.mean(x, axis=0, keepdims=True)
+    xc = x - mean
+    if normalize:
+        std = jnp.std(xc, axis=0, keepdims=True)
+        xc = xc / jnp.where(std == 0, 1.0, std)
+    # thin SVD of (N, D): principal axes are the right singular vectors
+    _, _, vt = jnp.linalg.svd(xc, full_matrices=False)
+    components = vt[:n_dims]  # (n_dims, D)
+    return xc @ components.T, components
+
+
+def pca(x, n_dims: int, normalize: bool = False) -> np.ndarray:
+    """Project ``x`` (N, D) onto its top ``n_dims`` principal components."""
+    x = jnp.asarray(x, jnp.float32)
+    projected, _ = _pca_project(x, min(n_dims, *x.shape), normalize)
+    return np.asarray(projected)
+
+
+def pca_factor(x, n_dims: int, normalize: bool = False):
+    """(projected, components) — components row-major (n_dims, D), for
+    reuse on new data via ``x_new @ components.T``."""
+    x = jnp.asarray(x, jnp.float32)
+    projected, components = _pca_project(x, min(n_dims, *x.shape), normalize)
+    return np.asarray(projected), np.asarray(components)

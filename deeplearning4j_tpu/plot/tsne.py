@@ -97,7 +97,11 @@ class Tsne:
         final_momentum: float = 0.8,
         stop_lying_iter: int = 100,
         seed: int = 0,
+        use_pca: bool = False,
+        pca_dims: int = 50,
     ):
+        self.use_pca = use_pca
+        self.pca_dims = pca_dims
         self.n_components = n_components
         self.perplexity = perplexity
         self.learning_rate = learning_rate
@@ -110,6 +114,10 @@ class Tsne:
     def calculate(self, x: np.ndarray) -> np.ndarray:
         """(N, D) -> (N, n_components) embedding (≙ Tsne.calculate:261)."""
         x = np.asarray(x, dtype=np.float32)
+        if self.use_pca:  # ≙ Tsne.java:262-263: PCA.pca(X, min(50, D), norm)
+            from deeplearning4j_tpu.ops.pca import pca
+
+            x = pca(x, min(self.pca_dims, x.shape[1]), normalize=True)
         p = jnp.asarray(p_affinities(x, self.perplexity), jnp.float32)
         key = jax.random.key(self.seed)
         y0 = 1e-4 * jax.random.normal(key, (x.shape[0], self.n_components))

@@ -1,0 +1,154 @@
+"""Tests for the parity gap-fill components: PCA, distributed GloVe,
+ImageLoader, cloud DataSet iteration, PoS tagging."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.base import DataSet, to_one_hot
+from deeplearning4j_tpu.datasets.cloud import (
+    CloudDataSetIterator,
+    LocalBucketClient,
+    upload_dataset_shards,
+)
+from deeplearning4j_tpu.datasets.image_loader import ImageLoader
+from deeplearning4j_tpu.nlp.pos import PosTagger, rule_tag
+from deeplearning4j_tpu.nlp.sentence_iterator import CollectionSentenceIterator
+from deeplearning4j_tpu.ops.pca import pca, pca_factor
+
+
+def test_pca_recovers_dominant_directions():
+    rng = np.random.default_rng(0)
+    # anisotropic 3D cloud: variance mostly along two known axes
+    base = rng.normal(size=(500, 2)) * np.array([10.0, 3.0])
+    mix = np.array([[1.0, 0.0, 0.5], [0.0, 1.0, -0.5]])
+    x = base @ mix + 0.01 * rng.normal(size=(500, 3))
+
+    proj = pca(x, 2)
+    assert proj.shape == (500, 2)
+    # top-2 components capture ~all the variance
+    total = np.var(x - x.mean(0), axis=0).sum()
+    kept = np.var(proj, axis=0).sum()
+    assert kept / total > 0.99
+
+    proj2, comps = pca_factor(x, 2)
+    assert comps.shape == (2, 3)
+    np.testing.assert_allclose(proj, proj2, rtol=1e-5, atol=1e-5)
+    # components are orthonormal
+    np.testing.assert_allclose(comps @ comps.T, np.eye(2), atol=1e-4)
+
+
+def test_pca_normalize_and_dim_clip():
+    x = np.random.default_rng(1).normal(size=(20, 4))
+    proj = pca(x, 10, normalize=True)  # n_dims clipped to D
+    assert proj.shape == (20, 4)
+
+
+def test_tsne_use_pca_path():
+    from deeplearning4j_tpu.plot.tsne import Tsne
+
+    x = np.random.default_rng(2).normal(size=(30, 10)).astype(np.float32)
+    y = Tsne(n_iter=20, perplexity=5.0, use_pca=True, pca_dims=5).calculate(x)
+    assert y.shape == (30, 2)
+    assert np.isfinite(y).all()
+
+
+def _glove_corpus(n):
+    pairs = [("ice", "cold"), ("steam", "hot"), ("king", "crown")]
+    rng = np.random.default_rng(3)
+    out = []
+    for _ in range(n):
+        a, b = pairs[rng.integers(len(pairs))]
+        filler = ["the", "of", "and"][rng.integers(3)]
+        out.append(f"{a} {b} {filler} {a} {b}")
+    return out
+
+def test_glove_distributed_matches_local_structure(devices):
+    from deeplearning4j_tpu.models.glove import Glove
+    from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+
+    g = Glove(layer_size=16, epochs=8, batch=512, seed=7)
+    g.fit_distributed(
+        CollectionSentenceIterator(_glove_corpus(150)),
+        mesh=data_parallel_mesh(8),
+    )
+    assert g.loss_history[-1] < g.loss_history[0]
+    # co-occurring pair closer than a non-co-occurring one
+    assert g.similarity("ice", "cold") > g.similarity("ice", "crown")
+
+
+def test_image_loader_roundtrip(tmp_path):
+    img = np.linspace(0, 255, 28 * 28, dtype=np.float32).reshape(28, 28)
+    path = tmp_path / "x.png"
+    ImageLoader.to_image(img, path)
+
+    loader = ImageLoader()
+    m = loader.as_matrix(path)
+    assert m.shape == (28, 28)
+    np.testing.assert_allclose(m, img, atol=1.0)
+
+    row = loader.as_row_vector(path)
+    assert row.shape == (1, 784)
+
+    resized = ImageLoader(width=14, height=14).as_matrix(path)
+    assert resized.shape == (14, 14)
+
+    batches = loader.as_mini_batches(path, 4, 7)
+    assert len(batches) == 4 and all(b.shape == (7, 28) for b in batches)
+
+
+def test_cloud_dataset_iterator_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+    ds = DataSet(
+        rng.normal(size=(40, 6)).astype(np.float32),
+        to_one_hot(rng.integers(0, 3, 40), 3),
+    )
+    client = LocalBucketClient(tmp_path / "bucket")
+    keys = upload_dataset_shards(client, ds, batch_size=10)
+    assert len(keys) == 4
+
+    it = CloudDataSetIterator(client)
+    parts = list(it)
+    assert len(parts) == 4
+    np.testing.assert_allclose(
+        np.concatenate([p.features for p in parts]), ds.features, rtol=1e-6
+    )
+
+    # reset + preprocessor hook
+    it2 = CloudDataSetIterator(
+        client, preprocessor=lambda d: DataSet(d.features * 2.0, d.labels)
+    )
+    first = next(iter(it2))
+    np.testing.assert_allclose(first.features, ds.features[:10] * 2.0, rtol=1e-6)
+    it2.reset()
+    assert it2.has_next()
+
+
+def test_pos_rule_backoff():
+    assert rule_tag("the") == "DET"
+    assert rule_tag("running") == "VERB"
+    assert rule_tag("quickly") == "ADV"
+    assert rule_tag("42") == "NUM"
+
+
+def test_pos_untrained_uses_rules():
+    tagger = PosTagger()
+    tags = dict(tagger.tag(["the", "dog", "runs", "quickly"]))
+    assert tags["the"] == "DET"
+    assert tags["quickly"] == "ADV"
+
+
+def test_pos_hmm_disambiguates_by_context():
+    # "can" is MD (modal) before a verb, NOUN after a determiner
+    corpus = []
+    for _ in range(20):
+        corpus.append([("i", "PRON"), ("can", "MD"), ("swim", "VERB")])
+        corpus.append([("the", "DET"), ("can", "NOUN"), ("fell", "VERB")])
+        corpus.append([("you", "PRON"), ("can", "MD"), ("run", "VERB")])
+        corpus.append([("a", "DET"), ("can", "NOUN"), ("sat", "VERB")])
+    tagger = PosTagger()
+    tagger.fit(corpus)
+    assert tagger.tag(["i", "can", "swim"])[1][1] == "MD"
+    assert tagger.tag(["the", "can", "fell"])[1][1] == "NOUN"
+    # OOV word between seen context still decodes
+    tagged = tagger.tag(["the", "zzzgadget", "fell"])
+    assert len(tagged) == 3
