@@ -78,6 +78,42 @@ def get_lib() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float),
     ]
+    lib.prefetch_create.restype = ctypes.c_void_p
+    lib.prefetch_create.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.prefetch_next.restype = ctypes.c_int64
+    lib.prefetch_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.prefetch_destroy.argtypes = [ctypes.c_void_p]
+    lib.vocab_create.restype = ctypes.c_void_p
+    lib.vocab_create.argtypes = [ctypes.c_int]
+    lib.vocab_add_text.restype = ctypes.c_int64
+    lib.vocab_add_text.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.vocab_size.restype = ctypes.c_int64
+    lib.vocab_size.argtypes = [ctypes.c_void_p]
+    lib.vocab_total_tokens.restype = ctypes.c_int64
+    lib.vocab_total_tokens.argtypes = [ctypes.c_void_p]
+    lib.vocab_dump.restype = ctypes.c_int64
+    lib.vocab_dump.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    lib.vocab_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
 
@@ -153,3 +189,169 @@ class NativeBatchAssembler:
             y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         )
         return x, y
+
+
+class PrefetchingLoader:
+    """Background-threaded native batch pipeline (bounded queue).
+
+    The C++ producer thread assembles the next shuffled float32/one-hot
+    minibatch while the device runs the current step — the overlap role of
+    the reference's job-dispensing BatchActor (BatchActor.java:31) plus
+    ND4J's native DataSet assembly, without holding the GIL.  Reshuffles
+    at each epoch boundary; iterate forever via :meth:`next_batch`.
+
+    Falls back to a same-semantics Python generator (no thread) when the
+    native library is unavailable.
+    """
+
+    def __init__(
+        self,
+        features_u8: np.ndarray,
+        labels_u8: np.ndarray,
+        num_classes: int,
+        batch_size: int,
+        seed: int = 0,
+        depth: int = 4,
+    ):
+        assert features_u8.dtype == np.uint8 and labels_u8.dtype == np.uint8
+        # keep references alive: the native side borrows these buffers
+        self.features = np.ascontiguousarray(
+            features_u8.reshape(features_u8.shape[0], -1)
+        )
+        self.labels = np.ascontiguousarray(labels_u8.reshape(-1))
+        self.num_classes = num_classes
+        self.batch_size = batch_size
+        self.row_len = self.features.shape[1]
+        self._lib = get_lib()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.prefetch_create(
+                self.features.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self.labels.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                len(self.labels),
+                self.row_len,
+                num_classes,
+                batch_size,
+                ctypes.c_uint64(seed),
+                depth,
+            )
+        if self._handle is None:
+            self._seed = seed
+            self._cursor = 0
+            self._epoch = 0
+            self._order = np.random.default_rng((seed, 0)).permutation(
+                len(self.labels)
+            )
+
+    def next_batch(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """Returns (x[batch, row_len] in [0,1], y one-hot, epoch)."""
+        if self._handle is None:
+            # same semantics as the native producer: every row is served
+            # once per epoch, batches wrap across the epoch boundary, and
+            # each epoch reshuffles keyed on (seed, epoch)
+            n = len(self.labels)
+            epoch_of_batch = self._epoch
+            rows = np.empty(self.batch_size, np.int64)
+            for r in range(self.batch_size):
+                if self._cursor >= n:
+                    self._epoch += 1
+                    self._cursor = 0
+                    self._order = np.random.default_rng(
+                        (self._seed, self._epoch)
+                    ).permutation(n)
+                rows[r] = self._order[self._cursor]
+                self._cursor += 1
+            x = self.features[rows].astype(np.float32) / 255.0
+            y = np.zeros((self.batch_size, self.num_classes), np.float32)
+            y[np.arange(self.batch_size), self.labels[rows]] = 1.0
+            return x, y, epoch_of_batch
+        x = np.empty((self.batch_size, self.row_len), np.float32)
+        y = np.empty((self.batch_size, self.num_classes), np.float32)
+        ep = self._lib.prefetch_next(
+            self._handle,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if ep < 0:
+            raise RuntimeError("prefetcher already closed")
+        return x, y, int(ep)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.prefetch_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort: stop the producer thread
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def count_vocab(
+    texts, min_count: int = 1, lowercase: bool = True
+) -> tuple[list[str], np.ndarray, int]:
+    """Tokenize + count words natively (≙ the reference's actor-parallel
+    vocab build, VocabActor.java:243).  Returns (words sorted by count
+    desc, counts, total_token_count); Python fallback when the native
+    library is missing."""
+    lib = get_lib()
+    if lib is None:
+        import re
+        from collections import Counter as _Counter
+
+        # mirror the native token-char set exactly: ASCII alnum, ', and
+        # any non-ASCII codepoint; lowercase only A-Z (the native side
+        # works on UTF-8 bytes and cannot case-fold beyond ASCII)
+        pat = re.compile(r"[A-Za-z0-9'\u0080-\U0010ffff]+")
+        ascii_lower = str.maketrans(
+            "ABCDEFGHIJKLMNOPQRSTUVWXYZ", "abcdefghijklmnopqrstuvwxyz"
+        )
+        c: _Counter = _Counter()
+        total = 0
+        for t in texts:
+            toks = pat.findall(t.translate(ascii_lower) if lowercase else t)
+            total += len(toks)
+            c.update(toks)
+        items = sorted(
+            ((w, n) for w, n in c.items() if n >= min_count),
+            key=lambda kv: (-kv[1], kv[0]),
+        )
+        words = [w for w, _ in items]
+        return words, np.array([n for _, n in items], np.int64), total
+
+    h = lib.vocab_create(1 if lowercase else 0)
+    try:
+        for t in texts:
+            b = t.encode("utf-8")
+            lib.vocab_add_text(h, b, len(b))
+        total = int(lib.vocab_total_tokens(h))
+        cap_words = int(lib.vocab_size(h))
+        buf_len = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(buf_len)
+            counts = np.zeros(max(cap_words, 1), np.int64)
+            n = lib.vocab_dump(
+                h,
+                min_count,
+                buf,
+                buf_len,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(counts),
+            )
+            if n >= 0:
+                words = (
+                    buf.raw[: _dump_bytes(buf.raw)].decode("utf-8").splitlines()
+                    if n
+                    else []
+                )
+                return words[: int(n)], counts[: int(n)], total
+            buf_len = -int(n) + 1  # returned the exact size needed
+    finally:
+        lib.vocab_destroy(h)
+
+
+def _dump_bytes(raw: bytes) -> int:
+    """Length of the newline-terminated dump region in a ctypes buffer."""
+    end = raw.rfind(b"\n")
+    return end + 1 if end >= 0 else 0

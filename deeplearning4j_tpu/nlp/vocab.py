@@ -57,6 +57,26 @@ class VocabCache:
                 self.total_word_count += c
         return self
 
+    def fit_texts(self, texts: Iterable[str], lowercase: bool = True) -> "VocabCache":
+        """Build the vocab straight from raw strings through the native C++
+        tokenizer/counter (≙ the reference's actor-parallel vocab build,
+        VocabActor.java:243) — one tight loop instead of per-sentence
+        Python tokenization; falls back to pure Python without a compiler.
+        """
+        from deeplearning4j_tpu import native_io
+
+        texts = list(texts)
+        words, counts, _total = native_io.count_vocab(
+            texts, min_count=self.min_word_frequency, lowercase=lowercase
+        )
+        self.num_docs += len(texts)
+        for word, c in zip(words, counts.tolist()):
+            vw = VocabWord(word, float(c), index=len(self.index_to_word))
+            self.vocab[word] = vw
+            self.index_to_word.append(word)
+            self.total_word_count += c
+        return self
+
     # -- lookups (≙ VocabCache iface) --------------------------------------
     def __contains__(self, word: str) -> bool:
         return word in self.vocab

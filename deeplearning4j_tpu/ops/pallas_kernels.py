@@ -58,6 +58,89 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int, scal
     o_ref[0] = (acc / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
 
 
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, kv_len: int, scale: float
+):
+    """Forward that also writes the per-row logsumexp (for the backward)."""
+    q = q_ref[0]
+    m = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
+    l = jnp.zeros((q.shape[0],), jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+
+    def body(start, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :]
+        v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1)
+        acc_new = corr[:, None] * acc + jnp.dot(
+            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, kv_len // block_k, body, (m, l, acc))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k: int, kv_len: int, scale: float,
+):
+    """dQ for one Q block: stream K/V blocks, recompute p from the saved
+    logsumexp (no T x T materialization)."""
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    dq = jnp.zeros(q.shape, jnp.float32)
+
+    def body(start, dq):
+        k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32) * scale
+
+    dq = jax.lax.fori_loop(0, kv_len // block_k, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q: int, q_len: int, scale: float,
+):
+    """dK/dV for one K/V block: stream Q blocks."""
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    dk = jnp.zeros(k_blk.shape, jnp.float32)
+    dv = jnp.zeros(v_blk.shape, jnp.float32)
+
+    def body(start, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(start * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(start * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(start * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(start * block_q, block_q)]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(0, q_len // block_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -94,6 +177,127 @@ def flash_attention(
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
     )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
+)
+def _flash_bhtd(qf, kf, vf, block_q, block_k, interpret):
+    out, _ = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret):
+    bh, t, d = qf.shape
+    scale = 1.0 / (d**0.5)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, kv_len=t, scale=scale
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out, lse
+
+
+def _flash_fwd_rule(qf, kf, vf, block_q, block_k, interpret):
+    out, lse = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret)
+    return out, (qf, kf, vf, out, lse)
+
+
+def _flash_bwd_rule(block_q, block_k, interpret, res, do):
+    qf, kf, vf, out, lse = res
+    bh, t, d = qf.shape
+    scale = 1.0 / (d**0.5)
+    # delta_i = <dO_i, O_i> — the softmax normalizer correction
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, kv_len=t, scale=scale
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, q_len=t, scale=scale
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), vf.dtype),
+        ),
+        grid=(bh, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, t), lambda i, j: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_trainable(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Differentiable flash attention: (B, T, H, D) in and out.
+
+    Forward saves only O and the per-row logsumexp; the backward pass is
+    two more pallas kernels (dQ; dK/dV) that stream blocks and recompute
+    probabilities — O(T) memory instead of the T x T attention matrix that
+    plain autodiff through dense attention would save.
+    """
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0
+    interpret = (not _on_tpu()) if interpret is None else interpret
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = _flash_bhtd(qf, kf, vf, block_q, block_k, interpret)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 

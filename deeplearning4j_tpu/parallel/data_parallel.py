@@ -79,6 +79,11 @@ class DataParallelTrainer:
             params = optax.apply_updates(state.params, updates)
             return TrainState(params, opt_state, state.step + 1), loss
 
+        self._raw_step = step
+        self._repl, self._shard = repl, shard
+        self._donate = donate
+        self._multi_cache: dict[int, Any] = {}
+        self._epoch_fn = None
         self._step = jax.jit(
             step,
             in_shardings=(repl, shard, shard, repl),
@@ -104,6 +109,64 @@ class DataParallelTrainer:
 
     def step(self, state: TrainState, x, y, key) -> tuple[TrainState, jax.Array]:
         return self._step(state, x, y, key)
+
+    def run_steps(
+        self, state: TrainState, x, y, key, n_steps: int
+    ) -> tuple[TrainState, jax.Array]:
+        """``n_steps`` optimizer steps on one sharded batch, fully in-graph.
+
+        One dispatch instead of ``n_steps`` — the whole loop is a
+        ``lax.scan`` inside a single jitted program (the in-graph analogue
+        of ``BaseOptimizer.optimize``'s ``numIterations`` loop,
+        BaseOptimizer.java:97), so per-step Python/runtime launch overhead
+        vanishes.  Returns ``(state, losses[n_steps])``.
+        """
+        fn = self._multi_cache.get(n_steps)
+        if fn is None:
+
+            def multi(state, x, y, key):
+                keys = jax.random.split(key, n_steps)
+                return lax.scan(
+                    lambda s, k: self._raw_step(s, x, y, k), state, keys
+                )
+
+            fn = jax.jit(
+                multi,
+                in_shardings=(self._repl, self._shard, self._shard, self._repl),
+                out_shardings=(self._repl, self._repl),
+                donate_argnums=(0,) if self._donate else (),
+            )
+            self._multi_cache[n_steps] = fn
+        return fn(state, x, y, key)
+
+    def fit_epoch(
+        self, state: TrainState, xs, ys, key
+    ) -> tuple[TrainState, jax.Array]:
+        """One pass over pre-staged minibatches ``xs[n, B, ...]`` in-graph.
+
+        The minibatch axis is scanned, the batch axis is sharded over the
+        data mesh axis — one compiled program per epoch shape.
+        """
+        if self._epoch_fn is None:
+            batch_shard = NamedSharding(
+                self.mesh, P(None, mesh_lib.DATA_AXIS)
+            )
+
+            def epoch(state, xs, ys, key):
+                keys = jax.random.split(key, xs.shape[0])
+                return lax.scan(
+                    lambda s, xyk: self._raw_step(s, xyk[0], xyk[1], xyk[2]),
+                    state,
+                    (xs, ys, keys),
+                )
+
+            self._epoch_fn = jax.jit(
+                epoch,
+                in_shardings=(self._repl, batch_shard, batch_shard, self._repl),
+                out_shardings=(self._repl, self._repl),
+                donate_argnums=(0,) if self._donate else (),
+            )
+        return self._epoch_fn(state, xs, ys, key)
 
 
 def local_sgd_step(

@@ -1,0 +1,155 @@
+// Native host-side prefetching batch pipeline.
+//
+// Role parity: the reference keeps the device fed through host-side job
+// dispensing (BatchActor.java:31 pulling jobs per available worker, plus
+// ND4J's native DataSet assembly).  On a TPU host the equivalent hot path
+// is overlap: assemble the NEXT shuffled minibatch on background threads
+// while the chip executes the current step.  This implements a bounded
+// producer/consumer queue of fully-assembled float32 batches (features
+// scaled to [0,1], labels one-hot), off the Python heap and outside the
+// GIL.  Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64_step(uint64_t* state) {
+  uint64_t z = (*state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Batch {
+  std::vector<float> x;
+  std::vector<float> y;
+  int64_t epoch = 0;  // epoch the batch's first row came from
+};
+
+struct Prefetcher {
+  const uint8_t* features;  // [n_rows, row_len], borrowed from caller
+  const uint8_t* labels;    // [n_rows], borrowed
+  int64_t n_rows, row_len, batch;
+  int num_classes;
+  uint64_t seed;
+  size_t depth;
+
+  std::vector<int64_t> order;
+  int64_t cursor = 0;  // next row within the current epoch
+  int64_t epoch = 0;
+
+  std::deque<Batch> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready;   // consumer waits
+  std::condition_variable cv_space;   // producer waits
+  bool stop = false;
+  std::thread producer;
+
+  void reshuffle() {
+    uint64_t st = seed + (uint64_t)epoch * 0x9e3779b97f4a7c15ULL + 1;
+    for (int64_t i = n_rows - 1; i > 0; i--) {
+      int64_t j = (int64_t)(splitmix64_step(&st) % (uint64_t)(i + 1));
+      std::swap(order[i], order[j]);
+    }
+  }
+
+  // cursor/epoch/order are producer-private: touched only by assemble()
+  // and reshuffle() on the producer thread; the consumer learns the epoch
+  // from the Batch it dequeues.
+  void assemble(Batch* b) {
+    b->x.resize((size_t)batch * row_len);
+    b->y.assign((size_t)batch * num_classes, 0.0f);
+    b->epoch = epoch;
+    for (int64_t r = 0; r < batch; r++) {
+      if (cursor >= n_rows) {  // epoch boundary: reshuffle, wrap
+        epoch++;
+        cursor = 0;
+        reshuffle();
+      }
+      int64_t src = order[cursor++];
+      const uint8_t* row = features + src * row_len;
+      float* dst = b->x.data() + r * row_len;
+      for (int64_t i = 0; i < row_len; i++) dst[i] = (float)row[i] / 255.0f;
+      int lbl = labels[src];
+      if (lbl >= 0 && lbl < num_classes)
+        b->y[(size_t)r * num_classes + lbl] = 1.0f;
+    }
+  }
+
+  void run() {
+    for (;;) {
+      Batch b;
+      assemble(&b);  // assembly happens outside the lock
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] { return stop || ready.size() < depth; });
+      if (stop) return;
+      ready.push_back(std::move(b));
+      cv_ready.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* prefetch_create(const uint8_t* features, const uint8_t* labels,
+                      int64_t n_rows, int64_t row_len, int num_classes,
+                      int64_t batch, uint64_t seed, int depth) {
+  if (!features || !labels || n_rows <= 0 || batch <= 0 || depth <= 0)
+    return nullptr;
+  Prefetcher* p = new Prefetcher();
+  p->features = features;
+  p->labels = labels;
+  p->n_rows = n_rows;
+  p->row_len = row_len;
+  p->num_classes = num_classes;
+  p->batch = batch;
+  p->seed = seed;
+  p->depth = (size_t)depth;
+  p->order.resize(n_rows);
+  for (int64_t i = 0; i < n_rows; i++) p->order[i] = i;
+  p->reshuffle();
+  p->producer = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Blocks until a batch is ready; copies into caller buffers.
+// Returns the epoch the batch came from (>=0), or -1 after destroy.
+int64_t prefetch_next(void* handle, float* out_x, float* out_y) {
+  Prefetcher* p = (Prefetcher*)handle;
+  Batch b;
+  int64_t ep;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_ready.wait(lk, [&] { return p->stop || !p->ready.empty(); });
+    if (p->stop && p->ready.empty()) return -1;
+    b = std::move(p->ready.front());
+    p->ready.pop_front();
+    ep = b.epoch;
+    p->cv_space.notify_one();
+  }
+  memcpy(out_x, b.x.data(), b.x.size() * sizeof(float));
+  memcpy(out_y, b.y.data(), b.y.size() * sizeof(float));
+  return ep;
+}
+
+void prefetch_destroy(void* handle) {
+  Prefetcher* p = (Prefetcher*)handle;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->cv_ready.notify_all();
+  p->cv_space.notify_all();
+  if (p->producer.joinable()) p->producer.join();
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,103 @@
+// Native tokenizer + vocabulary counter.
+//
+// Role parity: the reference parallelizes vocabulary construction with an
+// actor pipeline (VocabActor.java:243, Word2Vec.buildVocab:247) because
+// counting words over a big corpus is the host-side bottleneck before
+// embedding training starts.  Here the same job is one tight C++ loop:
+// lowercase + split on non-alphanumerics, open-addressing hash count.
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct VocabCounter {
+  std::unordered_map<std::string, int64_t> counts;
+  int64_t total_tokens = 0;
+  bool lowercase;
+};
+
+inline bool is_token_char(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '\'' || c >= 0x80;  // keep UTF-8 bytes
+}
+
+}  // namespace
+
+extern "C" {
+
+void* vocab_create(int lowercase) {
+  VocabCounter* v = new VocabCounter();
+  v->lowercase = lowercase != 0;
+  return v;
+}
+
+// Tokenize `len` bytes of text and fold the token counts in.
+// Returns the number of tokens seen in this call.
+int64_t vocab_add_text(void* handle, const char* text, int64_t len) {
+  VocabCounter* v = (VocabCounter*)handle;
+  int64_t n = 0;
+  std::string tok;
+  tok.reserve(32);
+  for (int64_t i = 0; i <= len; i++) {
+    unsigned char c = i < len ? (unsigned char)text[i] : ' ';
+    if (is_token_char(c)) {
+      if (v->lowercase && c >= 'A' && c <= 'Z') c = c - 'A' + 'a';
+      tok.push_back((char)c);
+    } else if (!tok.empty()) {
+      v->counts[tok] += 1;
+      n++;
+      tok.clear();
+    }
+  }
+  v->total_tokens += n;
+  return n;
+}
+
+int64_t vocab_size(void* handle) {
+  return (int64_t)((VocabCounter*)handle)->counts.size();
+}
+
+int64_t vocab_total_tokens(void* handle) {
+  return ((VocabCounter*)handle)->total_tokens;
+}
+
+// Serialize entries with count >= min_count, sorted by (count desc, word
+// asc), as "word\n" lines into `buf` (capacity buf_len) with the matching
+// counts in `out_counts` (capacity max_words).  Returns the number of
+// words written, or -(needed_bytes) if `buf` is too small.
+int64_t vocab_dump(void* handle, int64_t min_count, char* buf,
+                   int64_t buf_len, int64_t* out_counts, int64_t max_words) {
+  VocabCounter* v = (VocabCounter*)handle;
+  std::vector<std::pair<const std::string*, int64_t>> items;
+  items.reserve(v->counts.size());
+  int64_t needed = 0;
+  for (auto& kv : v->counts) {
+    if (kv.second >= min_count) {
+      items.emplace_back(&kv.first, kv.second);
+      needed += (int64_t)kv.first.size() + 1;
+    }
+  }
+  if (needed > buf_len || (int64_t)items.size() > max_words) return -needed;
+  std::sort(items.begin(), items.end(), [](const auto& a, const auto& b) {
+    if (a.second != b.second) return a.second > b.second;
+    return *a.first < *b.first;
+  });
+  char* w = buf;
+  for (size_t i = 0; i < items.size(); i++) {
+    memcpy(w, items[i].first->data(), items[i].first->size());
+    w += items[i].first->size();
+    *w++ = '\n';
+    out_counts[i] = items[i].second;
+  }
+  return (int64_t)items.size();
+}
+
+void vocab_destroy(void* handle) { delete (VocabCounter*)handle; }
+
+}  // extern "C"

@@ -199,3 +199,17 @@ def test_paragraph_vectors_dbow():
     assert pv.get_label_vector("daytime") is not None
     assert pv.infer_nearest_label("sun light bright day") == "daytime"
     assert pv.infer_nearest_label("moon stars dark night") == "nighttime"
+
+
+def test_vocab_fit_texts_native_matches_fit():
+    """fit_texts (native tokenizer+counter) == fit over the same tokens."""
+    from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+    texts = ["the cat sat on the mat", "the dog sat", "cat and dog play"]
+    toks = [t.split() for t in texts]
+    a = VocabCache(min_word_frequency=1).fit(toks)
+    b = VocabCache(min_word_frequency=1).fit_texts(texts)
+    assert set(a.words()) == set(b.words())
+    for w in a.words():
+        assert a.word_frequency(w) == b.word_frequency(w)
+    assert a.total_word_count == b.total_word_count

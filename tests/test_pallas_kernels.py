@@ -26,3 +26,26 @@ def test_fused_embedding_dot_matches_xla():
     out = fused_embedding_dot(h, w, mask, block_b=32, interpret=True)
     ref = jax.nn.sigmoid(jnp.clip(jnp.einsum("bd,bld->bl", h, w), -6, 6)) * mask
     assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def test_flash_attention_trainable_grads_match_dense():
+    """custom_vjp backward kernels (dQ, dK/dV) == autodiff through dense."""
+    from deeplearning4j_tpu.ops.pallas_kernels import flash_attention_trainable
+
+    ks = jax.random.split(jax.random.key(2), 3)
+    q, k, v = (jax.random.normal(kk, (2, 32, 2, 8)) for kk in ks)
+
+    def loss_flash(q, k, v):
+        o = flash_attention_trainable(q, k, v, block_q=8, block_k=8, interpret=True)
+        return jnp.sum(jnp.sin(o) * o)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(attention(q, k, v)) * attention(q, k, v))
+
+    out_f = loss_flash(q, k, v)
+    out_d = loss_dense(q, k, v)
+    assert abs(float(out_f) - float(out_d)) < 1e-3
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-3

@@ -114,3 +114,74 @@ def test_native_loader_builds_and_matches_numpy(tmp_path):
     # deterministic shuffle for a fixed seed
     asm2 = native_io.NativeBatchAssembler(feats, labels, num_classes=4, seed=7)
     assert (asm.order == asm2.order).all()
+
+
+def test_native_prefetching_loader_epochs_and_content():
+    """Prefetcher yields correct one-hot batches and advances epochs with a
+    reshuffle; works through the numpy fallback too."""
+    rng = np.random.default_rng(1)
+    feats = rng.integers(0, 256, (20, 6), dtype=np.uint8)
+    labels = rng.integers(0, 3, 20, dtype=np.uint8)
+    loader = native_io.PrefetchingLoader(
+        feats, labels, num_classes=3, batch_size=8, seed=3, depth=2
+    )
+    try:
+        label_of = {}
+        for i in range(20):
+            label_of[feats[i].tobytes()] = labels[i]
+        seen_epochs = set()
+        for _ in range(12):  # 12*8 rows > 4 epochs of 20
+            x, y, ep = loader.next_batch()
+            assert x.shape == (8, 6) and y.shape == (8, 3)
+            assert x.min() >= 0.0 and x.max() <= 1.0
+            seen_epochs.add(ep)
+            for r in range(8):
+                row_u8 = np.round(x[r] * 255.0).astype(np.uint8).tobytes()
+                assert y[r].argmax() == label_of[row_u8]
+                assert y[r].sum() == 1.0
+        assert len(seen_epochs) >= 2, "epoch counter never advanced"
+    finally:
+        loader.close()
+
+
+def test_native_vocab_counter_matches_python():
+    texts = [
+        "The quick brown fox jumps over the lazy dog",
+        "the dog barks; the fox runs!  Don't stop",
+        "fox fox FOX",
+    ]
+    words, counts, total = native_io.count_vocab(texts, min_count=1)
+    assert total == 9 + 8 + 3
+    d = dict(zip(words, counts.tolist()))
+    assert d["the"] == 4
+    assert d["fox"] == 5
+    assert d["dog"] == 2
+    assert d["don't"] == 1
+    # sorted by count desc
+    assert list(counts) == sorted(counts, reverse=True)
+    # min_count filter
+    w2, c2, _ = native_io.count_vocab(texts, min_count=2)
+    assert set(w2) == {"the", "fox", "dog"}
+
+
+def test_vocab_counter_non_ascii_parity():
+    """Native (UTF-8 byte) tokenizer and the Python fallback agree on
+    non-ASCII text: kept as token chars, only ASCII is case-folded."""
+    from deeplearning4j_tpu import native_io as nio
+
+    texts = ["café CAFÉ cafe (x)"]
+    native = nio.count_vocab(texts, 1) if nio.available() else None
+    # force the fallback path on a fresh module state
+    import importlib
+
+    saved = (nio._lib, nio._tried)
+    try:
+        nio._lib, nio._tried = None, True
+        fallback = nio.count_vocab(texts, 1)
+    finally:
+        nio._lib, nio._tried = saved
+    if native is not None:
+        assert native[0] == fallback[0]
+        assert native[1].tolist() == fallback[1].tolist()
+        assert native[2] == fallback[2]
+    assert "café" in fallback[0]
