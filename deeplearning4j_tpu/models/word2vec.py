@@ -40,6 +40,7 @@ from deeplearning4j_tpu.nlp.vocab import VocabCache
 log = logging.getLogger(__name__)
 
 MAX_EXP = 6.0  # ≙ the reference's exp-table domain
+_SCAN_WIDTH = 8  # HS batches folded into one dispatch by _hs_scan
 
 
 # -- jitted batch kernels -----------------------------------------------------
@@ -62,6 +63,25 @@ def _hs_math(syn0, syn1, inputs, codes, points, mask, lr):
 
 
 _hs_step = jax.jit(_hs_math, donate_argnums=(0, 1))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_scan(syn0, syn1, ins, tgts, codes, points, mask, lrs):
+    """k HS batch updates in one dispatch (lax.scan over stacked batches).
+
+    ins/tgts: (k, B); lrs: (k,).  The Huffman-path gather happens inside
+    the scan so only the compact (k, B) index arrays cross the host
+    boundary per flush.
+    """
+
+    def body(carry, xs):
+        s0, s1 = carry
+        i, t, lr = xs
+        s0, s1 = _hs_math(s0, s1, i, codes[t], points[t], mask[t], lr)
+        return (s0, s1), ()
+
+    (syn0, syn1), _ = jax.lax.scan(body, (syn0, syn1), (ins, tgts, lrs))
+    return syn0, syn1
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -193,12 +213,30 @@ class Word2Vec:
         mask = jnp.asarray(self._mask)
         table = jnp.asarray(self._table) if self._table is not None else None
 
+        from deeplearning4j_tpu import native_io
+
+        buf_sents: list[np.ndarray] = []
         buf_in: list[np.ndarray] = []
         buf_tg: list[np.ndarray] = []
-        buffered = 0
+        buffered = 0  # pairs carried over from a previous flush
+        buffered_words = 0
+        chunk_seed = self.seed
 
         def flush(final: bool = False):
-            nonlocal buffered
+            nonlocal buffered, buffered_words, chunk_seed
+            if buffered_words:
+                # one native pass enumerates every (context, center) pair in
+                # the buffered sentences (≙ the Java skipGram loop, now C++)
+                ins_c, tgts_c = native_io.sg_pairs_chunk(
+                    buf_sents, self.window, chunk_seed
+                )
+                chunk_seed += 1
+                buf_sents.clear()
+                buffered_words = 0
+                if len(ins_c):
+                    buf_in.append(ins_c)
+                    buf_tg.append(tgts_c)
+                    buffered += len(ins_c)
             if buffered == 0:
                 return
             ins = np.concatenate(buf_in)
@@ -211,7 +249,22 @@ class Word2Vec:
             # truncate instead (cheap, pairs are plentiful)
             b = self.batch_pairs
             n_full = len(ins) // b
-            for k in range(n_full):
+            done = 0
+            if self.use_hs and self.negative == 0:
+                # fixed-width scans (one compiled program) batch the
+                # dispatches; remainder batches go through the single step
+                K = _SCAN_WIDTH
+                lr_now = getattr(self, "_lr_now", self.lr)
+                while n_full - done >= K:
+                    sl = slice(done * b, (done + K) * b)
+                    ins_k = jnp.asarray(ins[sl].reshape(K, b))
+                    tgts_k = jnp.asarray(tgts[sl].reshape(K, b))
+                    lrs = jnp.full((K,), lr_now, jnp.float32)
+                    self.syn0, self.syn1 = _hs_scan(
+                        self.syn0, self.syn1, ins_k, tgts_k, codes, points, mask, lrs
+                    )
+                    done += K
+            for k in range(done, n_full):
                 sl = slice(k * b, (k + 1) * b)
                 self._train_batch(ins[sl], tgts[sl], codes, points, mask, table, rng)
             tail = len(ins) - n_full * b
@@ -225,6 +278,14 @@ class Word2Vec:
                 buf_tg.append(tgts[-tail:])
                 buffered = tail
 
+        # pair enumeration happens once per chunk in native code; buffering
+        # sentences (not pairs) keeps the Python loop to encode+subsample
+        approx_pairs_per_word = max(self.window, 1)  # E[span] ≈ window/2 each side
+        # ~one batch of pairs per flush: keeps the lr schedule fresh (the
+        # update math is identical either way, but batching many steps
+        # behind one stale lr measurably hurts small-corpus convergence);
+        # _hs_scan still folds multi-batch flushes into one dispatch
+        chunk_words = max(self.batch_pairs // approx_pairs_per_word, 64)
         for _ in range(self.epochs):
             sentences.reset()
             for sent in sentences:
@@ -233,13 +294,14 @@ class Word2Vec:
                 self._lr_now = max(
                     self.min_lr, self.lr * (1.0 - words_seen / total_words)
                 )
-                ins, tgts = skipgram_pairs(ids, self.window, rng)
-                if len(ins):
-                    buf_in.append(ins)
-                    buf_tg.append(tgts)
-                    buffered += len(ins)
-                if buffered >= self.batch_pairs:
+                if len(ids) >= 2:
+                    buf_sents.append(np.asarray(ids, np.int32))
+                    buffered_words += len(ids)
+                if buffered_words >= chunk_words:
                     flush()
+            # epoch boundary: train on what's buffered so small corpora
+            # still see an update per epoch with a fresh learning rate
+            flush()
         flush(final=True)
 
     def _train_batch(self, ins, tgts, codes, points, mask, table, rng):
@@ -300,20 +362,35 @@ class Word2Vec:
             )
         )
 
-        rng = np.random.default_rng(self.seed)
+        from deeplearning4j_tpu import native_io
+
         b = self.batch_pairs - self.batch_pairs % n_dev
         pend_i: list[np.ndarray] = []
         pend_t: list[np.ndarray] = []
+        pend_sents: list[np.ndarray] = []
+        pend_words = 0
         count = 0
+        chunk_no = 0
+        chunk_words = max(b // max(self.window, 1), 64)
         sentences.reset()
-        for sent in sentences:
-            ids = self.cache.encode(self.tokenize(sent))
-            ins, tgts = skipgram_pairs(ids, self.window, rng)
-            if not len(ins):
-                continue
-            pend_i.append(ins)
-            pend_t.append(tgts)
-            count += len(ins)
+
+        def drain_sentences():
+            nonlocal pend_words, chunk_no, count
+            if not pend_sents:
+                return
+            ins, tgts = native_io.sg_pairs_chunk(
+                pend_sents, self.window, self.seed + chunk_no
+            )
+            chunk_no += 1
+            pend_sents.clear()
+            pend_words = 0
+            if len(ins):
+                pend_i.append(ins)
+                pend_t.append(tgts)
+                count += len(ins)
+
+        def train_full_batches():
+            nonlocal pend_i, pend_t, count
             while count >= b:
                 allin = np.concatenate(pend_i)
                 alltg = np.concatenate(pend_t)
@@ -331,6 +408,17 @@ class Word2Vec:
                     mask[bt].reshape(n_dev, per, mask.shape[1]),
                     jnp.float32(self.lr),
                 )
+
+        for sent in sentences:
+            ids = self.cache.encode(self.tokenize(sent))
+            if len(ids) >= 2:
+                pend_sents.append(np.asarray(ids, np.int32))
+                pend_words += len(ids)
+            if pend_words >= chunk_words:
+                drain_sentences()
+            train_full_batches()
+        drain_sentences()
+        train_full_batches()  # tail < b pairs is dropped, as before
 
     # -- WordVectors API (≙ WordVectorsImpl.java:361) -----------------------
     def get_word_vector(self, word: str) -> np.ndarray | None:

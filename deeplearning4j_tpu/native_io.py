@@ -45,9 +45,16 @@ def get_lib() -> ctypes.CDLL | None:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not _SO.exists() and not _build():
+    # always run make before the first dlopen: a fresh build is a no-op,
+    # and a stale .so (older than its sources) must be rebuilt *before*
+    # loading — dlopen caches by path, so reloading after a rebuild is
+    # not reliable within one process
+    if not _build() and not _SO.exists():
         return None
     lib = ctypes.CDLL(str(_SO))
+    if not hasattr(lib, "sg_pairs"):  # stale .so and the rebuild failed
+        log.warning("native library is stale; using numpy fallback")
+        return None
     lib.read_idx.restype = ctypes.c_int
     lib.read_idx.argtypes = [
         ctypes.c_char_p,
@@ -114,6 +121,17 @@ def get_lib() -> ctypes.CDLL | None:
         ctypes.c_int64,
     ]
     lib.vocab_destroy.argtypes = [ctypes.c_void_p]
+    lib.sg_pairs.restype = ctypes.c_int64
+    lib.sg_pairs.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+    ]
     _lib = lib
     return _lib
 
@@ -224,6 +242,7 @@ class PrefetchingLoader:
         self.row_len = self.features.shape[1]
         self._lib = get_lib()
         self._handle = None
+        self._closed = False
         if self._lib is not None:
             self._handle = self._lib.prefetch_create(
                 self.features.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -245,12 +264,14 @@ class PrefetchingLoader:
 
     def next_batch(self) -> tuple[np.ndarray, np.ndarray, int]:
         """Returns (x[batch, row_len] in [0,1], y one-hot, epoch)."""
+        if self._closed:
+            raise RuntimeError("prefetcher already closed")
         if self._handle is None:
             # same semantics as the native producer: every row is served
             # once per epoch, batches wrap across the epoch boundary, and
             # each epoch reshuffles keyed on (seed, epoch)
             n = len(self.labels)
-            epoch_of_batch = self._epoch
+            epoch_of_batch = None
             rows = np.empty(self.batch_size, np.int64)
             for r in range(self.batch_size):
                 if self._cursor >= n:
@@ -259,6 +280,8 @@ class PrefetchingLoader:
                     self._order = np.random.default_rng(
                         (self._seed, self._epoch)
                     ).permutation(n)
+                if r == 0:  # label after any wrap, as the native side does
+                    epoch_of_batch = self._epoch
                 rows[r] = self._order[self._cursor]
                 self._cursor += 1
             x = self.features[rows].astype(np.float32) / 255.0
@@ -277,6 +300,7 @@ class PrefetchingLoader:
         return x, y, int(ep)
 
     def close(self) -> None:
+        self._closed = True
         if self._handle is not None:
             self._lib.prefetch_destroy(self._handle)
             self._handle = None
@@ -340,12 +364,14 @@ def count_vocab(
                 len(counts),
             )
             if n >= 0:
-                words = (
-                    buf.raw[: _dump_bytes(buf.raw)].decode("utf-8").splitlines()
-                    if n
-                    else []
-                )
-                return words[: int(n)], counts[: int(n)], total
+                # split on the 0x0A separators at the *byte* level: tokens
+                # can contain any non-ASCII codepoint, and str.splitlines
+                # would also split on U+0085/U+2028/U+2029 inside them
+                region = buf.raw[: _dump_bytes(buf.raw)]
+                words = [
+                    w.decode("utf-8") for w in region.split(b"\n")[: int(n)]
+                ]
+                return words, counts[: int(n)], total
             buf_len = -int(n) + 1  # returned the exact size needed
     finally:
         lib.vocab_destroy(h)
@@ -355,3 +381,68 @@ def _dump_bytes(raw: bytes) -> int:
     """Length of the newline-terminated dump region in a ctypes buffer."""
     end = raw.rfind(b"\n")
     return end + 1 if end >= 0 else 0
+
+
+def sg_pairs_chunk(
+    sentences: list[np.ndarray], window: int, seed: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Skip-gram (input, target) pairs for a chunk of encoded sentences.
+
+    One C++ pass over the whole chunk (≙ the reference's Java hot loop,
+    Word2Vec.skipGram:304, with b = random %% window per center); numpy
+    fallback reproduces identical pairs from the same splitmix64 stream.
+    """
+    if not sentences:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    ids = np.ascontiguousarray(np.concatenate(sentences).astype(np.int32))
+    offsets = np.zeros(len(sentences) + 1, np.int64)
+    np.cumsum([len(s) for s in sentences], out=offsets[1:])
+    cap = int(2 * window * len(ids)) + 1
+    lib = get_lib()
+    if lib is not None:
+        out_in = np.empty(cap, np.int32)
+        out_tg = np.empty(cap, np.int32)
+        n = lib.sg_pairs(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(sentences),
+            window,
+            ctypes.c_uint64(seed),
+            out_in.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_tg.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap,
+        )
+        if n < 0:
+            raise RuntimeError("sg_pairs capacity overflow (cap miscomputed)")
+        return out_in[:n].copy(), out_tg[:n].copy()
+
+    # fallback: same splitmix64 stream, same emission order
+    state = np.uint64(seed)
+    GOLD = np.uint64(0x9E3779B97F4A7C15)
+
+    def next_rand() -> int:
+        nonlocal state
+        with np.errstate(over="ignore"):
+            state = state + GOLD
+            z = state
+            z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            return int(z ^ (z >> np.uint64(31)))
+
+    ins: list[int] = []
+    tgts: list[int] = []
+    for s in sentences:
+        n = len(s)
+        if n < 2:
+            for _ in range(n):
+                next_rand()
+            continue
+        for i in range(n):
+            b = next_rand() % window
+            span = window - b
+            lo, hi = max(0, i - span), min(n, i + span + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    ins.append(int(s[j]))
+                    tgts.append(int(s[i]))
+    return np.asarray(ins, np.int32), np.asarray(tgts, np.int32)

@@ -1,0 +1,55 @@
+// Native skip-gram pair generation.
+//
+// Role parity: the reference's Word2Vec hot loop walks every (center,
+// context) pair in Java across a thread pool (Word2Vec.trainSentence:288,
+// skipGram:304) and gets its arithmetic speed from native BLAS underneath.
+// Here the arithmetic is batched on the TPU, so the host-side cost that
+// remains is enumerating training pairs; this does that for a whole chunk
+// of sentences in one C++ pass, with the reference's per-center random
+// window reduction (b = random % window).  C ABI for ctypes.
+
+#include <cstdint>
+
+#include "splitmix64.h"
+
+extern "C" {
+
+// ids: concatenated word indices for all sentences in the chunk.
+// offsets: n_sents+1 boundaries into ids (sentence s = [offsets[s],
+// offsets[s+1])).  For each center i a window reduction b = rand % window
+// is drawn and every context j != i within span (window - b) emits the
+// pair (input = ids[j], target = ids[i]).  Writes at most `cap` pairs;
+// returns the number written, or -1 if the buffers would overflow
+// (callers size cap to sum(len_s * 2 * window), which is an upper bound).
+int64_t sg_pairs(const int32_t* ids, const int64_t* offsets, int64_t n_sents,
+                 int window, uint64_t seed, int32_t* out_in, int32_t* out_tgt,
+                 int64_t cap) {
+  if (window <= 0) return 0;
+  uint64_t st = seed;
+  int64_t n_out = 0;
+  for (int64_t s = 0; s < n_sents; s++) {
+    const int64_t lo = offsets[s], hi = offsets[s + 1];
+    const int64_t n = hi - lo;
+    if (n < 2) {
+      // keep the RNG stream aligned with per-center draws
+      for (int64_t i = 0; i < n; i++) dl4jtpu_splitmix64(&st);
+      continue;
+    }
+    for (int64_t i = 0; i < n; i++) {
+      int64_t b = (int64_t)(dl4jtpu_splitmix64(&st) % (uint64_t)window);
+      int64_t span = window - b;
+      int64_t jlo = i - span < 0 ? 0 : i - span;
+      int64_t jhi = i + span + 1 > n ? n : i + span + 1;
+      for (int64_t j = jlo; j < jhi; j++) {
+        if (j == i) continue;
+        if (n_out >= cap) return -1;
+        out_in[n_out] = ids[lo + j];
+        out_tgt[n_out] = ids[lo + i];
+        n_out++;
+      }
+    }
+  }
+  return n_out;
+}
+
+}  // extern "C"

@@ -15,15 +15,9 @@
 #include <cstdlib>
 #include <cstring>
 
-extern "C" {
+#include "splitmix64.h"
 
-// splitmix64 — small, seedable, reproducible across platforms.
-static inline uint64_t splitmix64(uint64_t* state) {
-  uint64_t z = (*state += 0x9e3779b97f4a7c15ULL);
-  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
-  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
-  return z ^ (z >> 31);
-}
+extern "C" {
 
 // Parse an idx file. Returns 0 on success. Caller frees *out with
 // free_buffer. dims must hold up to 8 entries; *ndim receives the rank.
@@ -86,7 +80,7 @@ void u8_to_f32(const uint8_t* src, float* dst, int64_t n) {
 void shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
   uint64_t st = seed;
   for (int64_t i = n - 1; i > 0; i--) {
-    int64_t j = (int64_t)(splitmix64(&st) % (uint64_t)(i + 1));
+    int64_t j = (int64_t)(dl4jtpu_splitmix64(&st) % (uint64_t)(i + 1));
     int64_t tmp = idx[i];
     idx[i] = idx[j];
     idx[j] = tmp;

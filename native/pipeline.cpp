@@ -9,6 +9,8 @@
 // scaled to [0,1], labels one-hot), off the Python heap and outside the
 // GIL.  Exposed as a C ABI for ctypes (no pybind11 in this image).
 
+#include "splitmix64.h"
+
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -18,13 +20,6 @@
 #include <vector>
 
 namespace {
-
-inline uint64_t splitmix64_step(uint64_t* state) {
-  uint64_t z = (*state += 0x9e3779b97f4a7c15ULL);
-  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
-  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
-  return z ^ (z >> 31);
-}
 
 struct Batch {
   std::vector<float> x;
@@ -54,7 +49,7 @@ struct Prefetcher {
   void reshuffle() {
     uint64_t st = seed + (uint64_t)epoch * 0x9e3779b97f4a7c15ULL + 1;
     for (int64_t i = n_rows - 1; i > 0; i--) {
-      int64_t j = (int64_t)(splitmix64_step(&st) % (uint64_t)(i + 1));
+      int64_t j = (int64_t)(dl4jtpu_splitmix64(&st) % (uint64_t)(i + 1));
       std::swap(order[i], order[j]);
     }
   }
@@ -65,13 +60,13 @@ struct Prefetcher {
   void assemble(Batch* b) {
     b->x.resize((size_t)batch * row_len);
     b->y.assign((size_t)batch * num_classes, 0.0f);
-    b->epoch = epoch;
     for (int64_t r = 0; r < batch; r++) {
       if (cursor >= n_rows) {  // epoch boundary: reshuffle, wrap
         epoch++;
         cursor = 0;
         reshuffle();
       }
+      if (r == 0) b->epoch = epoch;  // label after any wrap of the first row
       int64_t src = order[cursor++];
       const uint8_t* row = features + src * row_len;
       float* dst = b->x.data() + r * row_len;

@@ -1,0 +1,16 @@
+// Shared splitmix64 PRNG step — the single source of truth for every
+// native component (and the contract the Python fallbacks reproduce
+// bit-exactly).  Keep in sync with nothing: include this, don't copy it.
+#ifndef DL4JTPU_SPLITMIX64_H_
+#define DL4JTPU_SPLITMIX64_H_
+
+#include <cstdint>
+
+static inline uint64_t dl4jtpu_splitmix64(uint64_t* state) {
+  uint64_t z = (*state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+#endif  // DL4JTPU_SPLITMIX64_H_

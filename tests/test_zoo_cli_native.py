@@ -185,3 +185,22 @@ def test_vocab_counter_non_ascii_parity():
         assert native[1].tolist() == fallback[1].tolist()
         assert native[2] == fallback[2]
     assert "café" in fallback[0]
+
+
+def test_prefetcher_epoch_label_at_exact_boundary():
+    """n divisible by batch: every batch is labeled with the epoch its rows
+    actually came from (native and fallback agree on the convention)."""
+    feats = np.arange(40 * 2, dtype=np.uint8).reshape(40, 2)
+    labels = np.zeros(40, np.uint8)
+    loader = native_io.PrefetchingLoader(
+        feats, labels, num_classes=2, batch_size=10, seed=0, depth=2
+    )
+    try:
+        eps = [loader.next_batch()[2] for _ in range(8)]
+        assert eps == [0, 0, 0, 0, 1, 1, 1, 1], eps
+    finally:
+        loader.close()
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        loader.next_batch()
