@@ -127,6 +127,72 @@ def skipgram_pairs(
     return np.asarray(ins, np.int32), np.asarray(tgts, np.int32)
 
 
+class _PairBuffer:
+    """Shared sentence→pair plumbing for ``fit`` and ``fit_distributed``.
+
+    Buffers encoded sentences and drains them through one native
+    ``sg_pairs_chunk`` pass per chunk (≙ the Java skipGram loop, now C++),
+    accumulating (input, target) pair arrays until the trainer consumes
+    them.  The chunk seed stream is ``seed, seed+1, ...`` so both training
+    paths see identical pair enumeration for the same corpus."""
+
+    def __init__(self, window: int, seed: int, chunk_words: int):
+        self.window = window
+        self.next_seed = seed
+        self.chunk_words = chunk_words
+        self.sents: list[np.ndarray] = []
+        self.words = 0
+        self._ins: list[np.ndarray] = []
+        self._tgts: list[np.ndarray] = []
+        self.count = 0  # pairs pending
+
+    @staticmethod
+    def words_per_chunk(batch_pairs: int, window: int) -> int:
+        # E[span] ≈ window/2 each side -> ~window pairs per word; size
+        # chunks to ~one batch of pairs so the lr schedule stays fresh
+        return max(batch_pairs // max(window, 1), 64)
+
+    def add(self, ids: list[int]) -> bool:
+        """Buffer one encoded sentence; True when a chunk is pending."""
+        if len(ids) >= 2:
+            self.sents.append(np.asarray(ids, np.int32))
+            self.words += len(ids)
+        return self.words >= self.chunk_words
+
+    def drain(self) -> None:
+        """Enumerate pairs for all buffered sentences in one native pass."""
+        if not self.sents:
+            return
+        from deeplearning4j_tpu import native_io
+
+        ins, tgts = native_io.sg_pairs_chunk(
+            self.sents, self.window, self.next_seed
+        )
+        self.next_seed += 1
+        self.sents.clear()
+        self.words = 0
+        if len(ins):
+            self._ins.append(ins)
+            self._tgts.append(tgts)
+            self.count += len(ins)
+
+    def take_all(self) -> tuple[np.ndarray, np.ndarray]:
+        ins = np.concatenate(self._ins) if self._ins else np.zeros(0, np.int32)
+        tgts = (
+            np.concatenate(self._tgts) if self._tgts else np.zeros(0, np.int32)
+        )
+        self._ins.clear()
+        self._tgts.clear()
+        self.count = 0
+        return ins, tgts
+
+    def put_back(self, ins: np.ndarray, tgts: np.ndarray) -> None:
+        if len(ins):
+            self._ins.append(ins)
+            self._tgts.append(tgts)
+            self.count += len(ins)
+
+
 class Word2Vec:
     """Skip-gram embeddings (Builder fields ≙ Word2Vec.Builder:397+)."""
 
@@ -213,37 +279,17 @@ class Word2Vec:
         mask = jnp.asarray(self._mask)
         table = jnp.asarray(self._table) if self._table is not None else None
 
-        from deeplearning4j_tpu import native_io
+        buf = _PairBuffer(
+            self.window,
+            self.seed,
+            _PairBuffer.words_per_chunk(self.batch_pairs, self.window),
+        )
 
-        buf_sents: list[np.ndarray] = []
-        buf_in: list[np.ndarray] = []
-        buf_tg: list[np.ndarray] = []
-        buffered = 0  # pairs carried over from a previous flush
-        buffered_words = 0
-        chunk_seed = self.seed
-
-        def flush(final: bool = False):
-            nonlocal buffered, buffered_words, chunk_seed
-            if buffered_words:
-                # one native pass enumerates every (context, center) pair in
-                # the buffered sentences (≙ the Java skipGram loop, now C++)
-                ins_c, tgts_c = native_io.sg_pairs_chunk(
-                    buf_sents, self.window, chunk_seed
-                )
-                chunk_seed += 1
-                buf_sents.clear()
-                buffered_words = 0
-                if len(ins_c):
-                    buf_in.append(ins_c)
-                    buf_tg.append(tgts_c)
-                    buffered += len(ins_c)
-            if buffered == 0:
+        def flush(train_tail: bool = False):
+            buf.drain()
+            if buf.count == 0:
                 return
-            ins = np.concatenate(buf_in)
-            tgts = np.concatenate(buf_tg)
-            buf_in.clear()
-            buf_tg.clear()
-            buffered = 0
+            ins, tgts = buf.take_all()
             # fixed-size batches keep one compiled kernel; pad the tail by
             # repeating index 0 pairs with lr 0 via mask-free trick: just
             # truncate instead (cheap, pairs are plentiful)
@@ -268,24 +314,20 @@ class Word2Vec:
                 sl = slice(k * b, (k + 1) * b)
                 self._train_batch(ins[sl], tgts[sl], codes, points, mask, table, rng)
             tail = len(ins) - n_full * b
-            if final and tail:
+            if train_tail and tail:
                 pad = b - tail
                 ins_t = np.concatenate([ins[-tail:], np.zeros(pad, np.int32)])
                 tgts_t = np.concatenate([tgts[-tail:], np.zeros(pad, np.int32)])
                 self._train_batch(ins_t, tgts_t, codes, points, mask, table, rng)
             elif tail:
-                buf_in.append(ins[-tail:])
-                buf_tg.append(tgts[-tail:])
-                buffered = tail
+                buf.put_back(ins[-tail:], tgts[-tail:])
 
         # pair enumeration happens once per chunk in native code; buffering
-        # sentences (not pairs) keeps the Python loop to encode+subsample
-        approx_pairs_per_word = max(self.window, 1)  # E[span] ≈ window/2 each side
-        # ~one batch of pairs per flush: keeps the lr schedule fresh (the
-        # update math is identical either way, but batching many steps
-        # behind one stale lr measurably hurts small-corpus convergence);
-        # _hs_scan still folds multi-batch flushes into one dispatch
-        chunk_words = max(self.batch_pairs // approx_pairs_per_word, 64)
+        # sentences (not pairs) keeps the Python loop to encode+subsample.
+        # Chunks hold ~one batch of pairs so the lr schedule stays fresh
+        # (batching many steps behind one stale lr measurably hurts
+        # small-corpus convergence); _hs_scan still folds multi-batch
+        # flushes into one dispatch
         for _ in range(self.epochs):
             sentences.reset()
             for sent in sentences:
@@ -294,15 +336,14 @@ class Word2Vec:
                 self._lr_now = max(
                     self.min_lr, self.lr * (1.0 - words_seen / total_words)
                 )
-                if len(ids) >= 2:
-                    buf_sents.append(np.asarray(ids, np.int32))
-                    buffered_words += len(ids)
-                if buffered_words >= chunk_words:
+                if buf.add(ids):
                     flush()
-            # epoch boundary: train on what's buffered so small corpora
-            # still see an update per epoch with a fresh learning rate
+            # epoch boundary: train all *full* batches buffered; a
+            # sub-batch tail carries over to the next epoch (padding it
+            # with junk (0,0) pairs every epoch measurably degrades
+            # small-corpus embeddings — only the single final flush pads)
             flush()
-        flush(final=True)
+        flush(train_tail=True)
 
     def _train_batch(self, ins, tgts, codes, points, mask, table, rng):
         lr = jnp.float32(getattr(self, "_lr_now", self.lr))
@@ -362,42 +403,17 @@ class Word2Vec:
             )
         )
 
-        from deeplearning4j_tpu import native_io
-
         b = self.batch_pairs - self.batch_pairs % n_dev
-        pend_i: list[np.ndarray] = []
-        pend_t: list[np.ndarray] = []
-        pend_sents: list[np.ndarray] = []
-        pend_words = 0
-        count = 0
-        chunk_no = 0
-        chunk_words = max(b // max(self.window, 1), 64)
+        buf = _PairBuffer(
+            self.window, self.seed, _PairBuffer.words_per_chunk(b, self.window)
+        )
         sentences.reset()
 
-        def drain_sentences():
-            nonlocal pend_words, chunk_no, count
-            if not pend_sents:
-                return
-            ins, tgts = native_io.sg_pairs_chunk(
-                pend_sents, self.window, self.seed + chunk_no
-            )
-            chunk_no += 1
-            pend_sents.clear()
-            pend_words = 0
-            if len(ins):
-                pend_i.append(ins)
-                pend_t.append(tgts)
-                count += len(ins)
-
         def train_full_batches():
-            nonlocal pend_i, pend_t, count
-            while count >= b:
-                allin = np.concatenate(pend_i)
-                alltg = np.concatenate(pend_t)
-                batch_i, rest_i = allin[:b], allin[b:]
-                batch_t, rest_t = alltg[:b], alltg[b:]
-                pend_i, pend_t = [rest_i], [rest_t]
-                count = len(rest_i)
+            while buf.count >= b:
+                allin, alltg = buf.take_all()
+                batch_i, batch_t = allin[:b], alltg[:b]
+                buf.put_back(allin[b:], alltg[b:])
                 per = b // n_dev
                 bi = jnp.asarray(batch_i).reshape(n_dev, per)
                 bt = jnp.asarray(batch_t)
@@ -411,13 +427,10 @@ class Word2Vec:
 
         for sent in sentences:
             ids = self.cache.encode(self.tokenize(sent))
-            if len(ids) >= 2:
-                pend_sents.append(np.asarray(ids, np.int32))
-                pend_words += len(ids)
-            if pend_words >= chunk_words:
-                drain_sentences()
+            if buf.add(ids):
+                buf.drain()
             train_full_batches()
-        drain_sentences()
+        buf.drain()
         train_full_batches()  # tail < b pairs is dropped, as before
 
     # -- WordVectors API (≙ WordVectorsImpl.java:361) -----------------------

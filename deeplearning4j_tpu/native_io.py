@@ -52,9 +52,20 @@ def get_lib() -> ctypes.CDLL | None:
     if not _build() and not _SO.exists():
         return None
     lib = ctypes.CDLL(str(_SO))
-    if not hasattr(lib, "sg_pairs"):  # stale .so and the rebuild failed
-        log.warning("native library is stale; using numpy fallback")
+    try:
+        _bind(lib)
+    except AttributeError as e:
+        # stale .so missing a symbol and the rebuild failed: fall back
+        # rather than crash at some later call site
+        log.warning("native library is stale (%s); using numpy fallback", e)
         return None
+    _lib = lib
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare every exported symbol's signature; raises AttributeError on
+    any missing symbol so a stale .so routes to the numpy fallback."""
     lib.read_idx.restype = ctypes.c_int
     lib.read_idx.argtypes = [
         ctypes.c_char_p,
@@ -132,8 +143,6 @@ def get_lib() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int64,
     ]
-    _lib = lib
-    return _lib
 
 
 def available() -> bool:
@@ -431,6 +440,8 @@ def sg_pairs_chunk(
 
     ins: list[int] = []
     tgts: list[int] = []
+    if window <= 0:  # same guard as corpus.cpp: no context -> no pairs
+        return np.asarray(ins, np.int32), np.asarray(tgts, np.int32)
     for s in sentences:
         n = len(s)
         if n < 2:

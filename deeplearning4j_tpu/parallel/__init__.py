@@ -13,3 +13,10 @@ from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
     DataParallelTrainer,
     local_sgd_step,
 )
+from deeplearning4j_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    pipeline_apply,
+    pipeline_mesh,
+    pipeline_train_step,
+    split_microbatches,
+    stack_stage_params,
+)
