@@ -13,6 +13,13 @@ from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
     DataParallelTrainer,
     local_sgd_step,
 )
+from deeplearning4j_tpu.parallel.expert_parallel import (  # noqa: F401
+    MoEParams,
+    init_moe_params,
+    moe_apply,
+    moe_reference,
+    place_moe_params,
+)
 from deeplearning4j_tpu.parallel.pipeline_parallel import (  # noqa: F401
     pipeline_apply,
     pipeline_mesh,

@@ -1,0 +1,208 @@
+"""Expert parallelism (MoE) — beyond-parity capability.
+
+The reference has no expert parallelism (SURVEY §2 P7: absent). This module
+provides the TPU-native version: a mixture-of-experts feed-forward block
+whose experts are sharded one-per-device over the mesh's ``expert`` axis,
+with GShard-style top-k token routing. Tokens are data-sharded over the
+*same* axis, so dispatch and return are each exactly one
+``lax.all_to_all`` over ICI — the canonical EP communication pattern.
+
+Design notes (TPU-first):
+- Static shapes everywhere: a fixed per-expert ``capacity`` buffer
+  ``(E, C, D)`` absorbs routing imbalance; overflow tokens are dropped
+  (their combine weight is zero), as in GShard/Switch.
+- Dispatch/combine are expressed as dense einsums against a 0/1 dispatch
+  mask ``(T, E, C)`` — matmuls the MXU tiles, instead of data-dependent
+  gathers XLA can't vectorize.
+- The router (tiny ``(D, E)`` matmul) is replicated; gradient flows
+  through the normalized top-k gate weights, and a Switch-style auxiliary
+  load-balancing loss is returned alongside the output.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import mesh as mesh_lib
+
+AXIS = mesh_lib.EXPERT_AXIS
+
+
+class MoEParams(NamedTuple):
+    """Router + stacked expert FFN weights.
+
+    Expert tensors carry a leading ``(E, ...)`` axis sharded over the
+    expert mesh axis; the router is replicated.
+    """
+
+    wg: jax.Array  # (D, E) router
+    w1: jax.Array  # (E, D, H)
+    b1: jax.Array  # (E, H)
+    w2: jax.Array  # (E, H, D)
+    b2: jax.Array  # (E, D)
+
+
+def init_moe_params(
+    key, d_model: int, d_hidden: int, num_experts: int, dtype=jnp.float32
+) -> MoEParams:
+    kg, k1, k2 = jax.random.split(key, 3)
+    s_in = 1.0 / jnp.sqrt(d_model)
+    s_hid = 1.0 / jnp.sqrt(d_hidden)
+    return MoEParams(
+        wg=(jax.random.normal(kg, (d_model, num_experts)) * s_in).astype(dtype),
+        w1=(
+            jax.random.normal(k1, (num_experts, d_model, d_hidden)) * s_in
+        ).astype(dtype),
+        b1=jnp.zeros((num_experts, d_hidden), dtype),
+        w2=(
+            jax.random.normal(k2, (num_experts, d_hidden, d_model)) * s_hid
+        ).astype(dtype),
+        b2=jnp.zeros((num_experts, d_model), dtype),
+    )
+
+
+def place_moe_params(mesh, params: MoEParams) -> MoEParams:
+    """Device-put params with EP shardings (experts split, router replicated)."""
+    ex = NamedSharding(mesh, P(AXIS))
+    rep = NamedSharding(mesh, P())
+    return MoEParams(
+        wg=jax.device_put(params.wg, rep),
+        w1=jax.device_put(params.w1, ex),
+        b1=jax.device_put(params.b1, ex),
+        w2=jax.device_put(params.w2, ex),
+        b2=jax.device_put(params.b2, ex),
+    )
+
+
+def _top_k_dispatch(gates, k: int, capacity: int):
+    """Build dispatch mask (T, E, C) and combine weights (T, E, C).
+
+    Sequential top-k with per-expert cumulative position counting
+    (GShard alg. 1): choice j's slots start after the tokens already
+    placed by choices < j. Tokens whose slot index >= capacity drop.
+
+    Slot counting runs in float32 regardless of the gate dtype: bf16
+    cumsum collides past 256 tokens, which would silently merge distinct
+    tokens into one capacity slot.
+    """
+    t, e = gates.shape
+    f32 = jnp.float32
+    remaining = gates.astype(f32)
+    counts = jnp.zeros((e,), f32)
+    dispatch = jnp.zeros((t, e, capacity), f32)
+    gate_sum = jnp.zeros((t,), f32)
+    combine = jnp.zeros((t, e, capacity), f32)
+    route_frac = jnp.zeros((e,), f32)
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=1)  # (T,)
+        onehot = jax.nn.one_hot(idx, e, dtype=f32)  # (T, E)
+        # pre-capacity routed fraction: the load-balancing loss must see
+        # the router's true assignment, not the post-drop dispatch, or
+        # gradient pressure vanishes exactly when an expert overflows
+        route_frac = route_frac + jnp.mean(onehot, axis=0) / k
+        gate_j = jnp.sum(gates.astype(f32) * onehot, axis=1)  # (T,)
+        pos = jnp.cumsum(onehot, axis=0) - 1 + counts  # (T, E)
+        counts = counts + jnp.sum(onehot, axis=0)
+        slot = jnp.sum(pos * onehot, axis=1).astype(jnp.int32)  # (T,)
+        keep = (slot < capacity).astype(f32)
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=f32)
+        d_j = (onehot * keep[:, None])[:, :, None] * slot_oh[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + gate_j[:, None, None] * d_j
+        gate_sum = gate_sum + gate_j * keep
+        remaining = remaining * (1.0 - onehot)
+    if k > 1:
+        # normalize surviving top-k gate weights to sum to 1 per token;
+        # at k=1 keep the raw gate multiplier (Switch) — g/g == 1 would
+        # cancel the router's task gradient exactly
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+    dt = gates.dtype
+    return dispatch.astype(dt), combine.astype(dt), route_frac.astype(dt)
+
+
+def moe_apply(mesh, *, k: int = 2, capacity_factor: float = 2.0,
+              activation=jax.nn.relu):
+    """Build the jitted EP MoE forward: fn(params, x) -> (y, aux_loss).
+
+    ``x`` is ``(T, D)`` tokens sharded over the expert axis (data-sharded);
+    ``y`` has the same sharding. ``aux_loss`` is the Switch load-balancing
+    loss ``E * sum_e(f_e * P_e)`` (floor 1.0 when perfectly balanced),
+    already averaged over the mesh.
+    """
+    n_exp = mesh.shape[AXIS]
+
+    def per_device(params: MoEParams, x):
+        # x: (T_local, D); expert tensors carry local slice (1, ...)
+        if params.w1.shape[0] != 1:
+            raise ValueError(
+                f"moe_apply assumes one expert per device: num_experts must "
+                f"equal the mesh's {AXIS!r} size ({n_exp}), got a per-device "
+                f"block of {params.w1.shape[0]}"
+            )
+        t_local, d = x.shape
+        capacity = max(1, int(capacity_factor * k * t_local / n_exp))
+        gates = jax.nn.softmax(x @ params.wg, axis=-1)  # (T, E)
+        dispatch, combine, route_frac = _top_k_dispatch(gates, k, capacity)
+        # Switch aux loss E * sum_e(f_e * P_e) on the pre-capacity routed
+        # fractions, averaged over the mesh
+        mean_prob = jnp.mean(gates, axis=0)
+        aux = n_exp * jnp.sum(route_frac * mean_prob)
+        aux = lax.pmean(aux, AXIS)
+
+        # dispatch: (T, D) x (T, E, C) -> (E, C, D), then one all-to-all so
+        # device e holds every source shard's bucket for expert e
+        buckets = jnp.einsum("td,tec->ecd", x, dispatch)
+        buckets = lax.all_to_all(
+            buckets, AXIS, split_axis=0, concat_axis=0, tiled=True
+        )  # (E_src, C, D) on the device owning this expert
+        w1 = params.w1[0]
+        w2 = params.w2[0]
+        h = activation(
+            jnp.einsum("scd,dh->sch", buckets, w1) + params.b1[0]
+        )
+        out = jnp.einsum("sch,hd->scd", h, w2) + params.b2[0]
+        # return trip + weighted combine back to token order (combine is
+        # zero on unoccupied capacity slots, so padding never leaks)
+        out = lax.all_to_all(
+            out, AXIS, split_axis=0, concat_axis=0, tiled=True
+        )  # (E, C, D) indexed by expert again
+        y = jnp.einsum("ecd,tec->td", out, combine)
+        return y, aux
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(
+            MoEParams(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            P(AXIS),
+        ),
+        out_specs=(P(AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def moe_reference(params: MoEParams, x, *, k: int = 2,
+                  activation=jax.nn.relu):
+    """Unsharded single-device reference (no capacity limit) for testing:
+    every token is processed by its true top-k experts."""
+    gates = jax.nn.softmax(x @ params.wg, axis=-1)
+    _, top_idx = lax.top_k(gates, k)  # (T, k)
+    top_gates = jnp.take_along_axis(gates, top_idx, axis=1)
+    if k > 1:  # k=1 keeps the raw gate multiplier (Switch)
+        top_gates = top_gates / jnp.sum(top_gates, axis=1, keepdims=True)
+
+    def expert_out(e, xt):
+        h = activation(xt @ params.w1[e] + params.b1[e])
+        return h @ params.w2[e] + params.b2[e]
+
+    def per_token(xt, idx, g):
+        outs = jnp.stack([expert_out(idx[j], xt) for j in range(k)])
+        return jnp.sum(g[:, None] * outs, axis=0)
+
+    return jax.vmap(per_token)(x, top_idx, top_gates)

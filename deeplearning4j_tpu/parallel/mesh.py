@@ -17,20 +17,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+
+
+def _mesh_1d(axis: str, n_devices: int | None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices for a {axis!r} mesh, "
+                f"have {len(devs)}"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
 
 
 def data_parallel_mesh(n_devices: int | None = None) -> Mesh:
     """1-D mesh over all (or the first n) local devices."""
-    devs = jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    return Mesh(np.array(devs), (DATA_AXIS,))
+    return _mesh_1d(DATA_AXIS, n_devices)
 
 
 def dp_mp_mesh(dp: int, mp: int) -> Mesh:
     """2-D (data, model) mesh — tensor-parallel hooks beyond parity."""
     devs = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
     return Mesh(devs, (DATA_AXIS, MODEL_AXIS))
+
+
+def expert_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D expert mesh: tokens are data-sharded over the same devices that
+    hold the experts (GShard layout), so dispatch is one all-to-all."""
+    return _mesh_1d(EXPERT_AXIS, n_devices)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
