@@ -39,8 +39,15 @@ def data_parallel_mesh(n_devices: int | None = None) -> Mesh:
 
 def dp_mp_mesh(dp: int, mp: int) -> Mesh:
     """2-D (data, model) mesh — tensor-parallel hooks beyond parity."""
-    devs = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
-    return Mesh(devs, (DATA_AXIS, MODEL_AXIS))
+    devs = jax.devices()
+    if len(devs) < dp * mp:
+        raise ValueError(
+            f"need {dp * mp} devices for a ({dp}, {mp}) mesh, "
+            f"have {len(devs)}"
+        )
+    return Mesh(
+        np.array(devs[: dp * mp]).reshape(dp, mp), (DATA_AXIS, MODEL_AXIS)
+    )
 
 
 def expert_mesh(n_devices: int | None = None) -> Mesh:
