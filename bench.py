@@ -16,14 +16,25 @@ import time
 from pathlib import Path
 
 BASELINE_FILE = Path(__file__).parent / "bench_baseline.json"
+CACHE_DIR = Path(__file__).parent / ".jax_cache"
 
 BATCH = 1024
 WARMUP = 10
 STEPS = 30
+MIN_TIMED_SECONDS = 1.0  # repeat the scanned program until the window is
+# long enough that dispatch overhead and timer noise are negligible
 
 
 def main() -> None:
     import jax
+
+    # persistent compile cache: the 30-step scanned program compiles once
+    # per (program, platform) ever, instead of ~minutes over the TPU
+    # tunnel on every bench invocation
+    CACHE_DIR.mkdir(exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(CACHE_DIR))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -52,12 +63,25 @@ def main() -> None:
         state, _ = trainer.run_steps(state, x, y, jax.random.key(i), STEPS)
     jax.block_until_ready(state.params)
 
+    # calibrate the repeat count so the timed window is >= MIN_TIMED_SECONDS
     t0 = time.perf_counter()
-    state, losses = trainer.run_steps(state, x, y, jax.random.key(1), STEPS)
+    state, _ = trainer.run_steps(state, x, y, jax.random.key(1), STEPS)
+    jax.block_until_ready(state.params)
+    once = time.perf_counter() - t0
+    reps = max(1, int(MIN_TIMED_SECONDS / max(once, 1e-6)) + 1)
+
+    t0 = time.perf_counter()
+    for r in range(reps):
+        state, losses = trainer.run_steps(
+            state, x, y, jax.random.key(2 + r), STEPS
+        )
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
-    samples_per_sec = BATCH * STEPS / dt
+    final_losses = np.asarray(losses)
+    assert np.isfinite(final_losses).all(), "bench produced non-finite loss"
+
+    samples_per_sec = BATCH * STEPS * reps / dt
     per_chip = samples_per_sec / n_chips
 
     platform = jax.devices()[0].platform

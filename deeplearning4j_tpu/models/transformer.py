@@ -31,6 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.ops.attention import attention
 from deeplearning4j_tpu.parallel import mesh as mesh_lib
+from deeplearning4j_tpu.parallel.expert_parallel import MoEParams, moe_ffn
+from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +45,15 @@ class TransformerConfig:
     max_len: int = 256
     remat: bool = False
     compute_dtype: Any = jnp.float32
+    # expert parallelism: n_experts > 0 swaps the dense MLP for a routed
+    # MoE FFN with experts one-per-device on the mesh's model axis
+    n_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 2.0
+    aux_coef: float = 0.01
+    # sequence parallelism: shard the sequence over the data axis and run
+    # ring attention (heads stay TP-sharded on the model axis)
+    sequence_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -52,7 +63,7 @@ class TransformerConfig:
 
 def init_transformer(key, cfg: TransformerConfig):
     """Params pytree; block tensors carry a leading (n_layers, ...) axis."""
-    ks = jax.random.split(key, 7)
+    ks = jax.random.split(key, 8)  # ks[7] only consumed by the MoE branch
     d, h, k, f, nl = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
     )
@@ -62,6 +73,24 @@ def init_transformer(key, cfg: TransformerConfig):
     def norm(key, shape, scale):
         return jax.random.normal(key, shape, jnp.float32) * scale
 
+    if cfg.n_experts:
+        e = cfg.n_experts
+        ffn = {
+            "moe": MoEParams(
+                wg=norm(ks[4], (nl, d, e), s_d),
+                w1=norm(ks[5], (nl, e, d, f), s_d),
+                b1=jnp.zeros((nl, e, f)),
+                w2=norm(ks[7], (nl, e, f, d), s_f),
+                b2=jnp.zeros((nl, e, d)),
+            )
+        }
+    else:
+        ffn = {
+            "w1": norm(ks[4], (nl, d, f), s_d),
+            "b1": jnp.zeros((nl, f)),
+            "w2": norm(ks[5], (nl, f, d), s_f),
+            "b2": jnp.zeros((nl, d)),
+        }
     return {
         "embed": norm(ks[0], (cfg.vocab_size, d), 0.02),
         "pos": norm(ks[1], (cfg.max_len, d), 0.02),
@@ -72,10 +101,7 @@ def init_transformer(key, cfg: TransformerConfig):
             "wo": norm(ks[3], (nl, h, k, d), s_d),
             "ln2_scale": jnp.ones((nl, d)),
             "ln2_bias": jnp.zeros((nl, d)),
-            "w1": norm(ks[4], (nl, d, f), s_d),
-            "b1": jnp.zeros((nl, f)),
-            "w2": norm(ks[5], (nl, f, d), s_f),
-            "b2": jnp.zeros((nl, d)),
+            **ffn,
         },
         "lnf_scale": jnp.ones((d,)),
         "lnf_bias": jnp.zeros((d,)),
@@ -83,7 +109,7 @@ def init_transformer(key, cfg: TransformerConfig):
     }
 
 
-def transformer_shardings(mesh: Mesh):
+def transformer_shardings(mesh: Mesh, cfg: TransformerConfig | None = None):
     """Megatron TP layout over the mesh's model axis, as a shardings pytree
     mirroring ``init_transformer``'s output."""
     m = mesh_lib.MODEL_AXIS
@@ -92,6 +118,24 @@ def transformer_shardings(mesh: Mesh):
         return NamedSharding(mesh, P(*spec))
 
     rep = ns()
+    if cfg is not None and cfg.n_experts:
+        # experts one-per-device on the model axis; router replicated
+        ffn = {
+            "moe": MoEParams(
+                wg=rep,
+                w1=ns(None, m, None, None),
+                b1=ns(None, m, None),
+                w2=ns(None, m, None, None),
+                b2=ns(None, m, None),
+            )
+        }
+    else:
+        ffn = {
+            "w1": ns(None, None, m),  # column-parallel on d_ff
+            "b1": ns(None, m),
+            "w2": ns(None, m, None),  # row-parallel
+            "b2": rep,
+        }
     return {
         "embed": rep,
         "pos": rep,
@@ -104,10 +148,7 @@ def transformer_shardings(mesh: Mesh):
             "wo": ns(None, m, None, None),
             "ln2_scale": rep,
             "ln2_bias": rep,
-            "w1": ns(None, None, m),  # column-parallel on d_ff
-            "b1": ns(None, m),
-            "w2": ns(None, m, None),  # row-parallel
-            "b2": rep,
+            **ffn,
         },
         "lnf_scale": rep,
         "lnf_bias": rep,
@@ -115,9 +156,9 @@ def transformer_shardings(mesh: Mesh):
     }
 
 
-def place_transformer_params(mesh: Mesh, params):
+def place_transformer_params(mesh: Mesh, params, cfg=None):
     return jax.tree.map(
-        jax.device_put, params, transformer_shardings(mesh)
+        jax.device_put, params, transformer_shardings(mesh, cfg)
     )
 
 
@@ -129,8 +170,37 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return out.astype(x.dtype)
 
 
-def transformer_apply(cfg: TransformerConfig):
-    """Build apply(params, tokens) -> logits (B, T, V), causal."""
+def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
+    """Build apply(params, tokens) -> (logits (B, T, V), aux_loss), causal.
+
+    ``mesh`` is required for the MoE (``cfg.n_experts``) and
+    ``cfg.sequence_parallel`` modes — both embed shard_map collectives
+    inside the jitted forward; the dense/dp-only model needs no mesh.
+    """
+    if (cfg.n_experts or cfg.sequence_parallel) and mesh is None:
+        raise ValueError("MoE / sequence-parallel modes need a mesh")
+    if cfg.n_experts:
+        if cfg.n_experts != mesh.shape[mesh_lib.MODEL_AXIS]:
+            raise ValueError(
+                f"n_experts ({cfg.n_experts}) must equal the mesh's model "
+                f"axis size ({mesh.shape[mesh_lib.MODEL_AXIS]})"
+            )
+        token_spec = (
+            P(None, mesh_lib.DATA_AXIS, None)
+            if cfg.sequence_parallel
+            else P(mesh_lib.DATA_AXIS, None, None)
+        )
+        moe = moe_ffn(
+            mesh,
+            k=cfg.moe_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            token_spec=token_spec,
+        )
+    if cfg.sequence_parallel:
+        # sequence ring over the data axis; heads stay on the model axis
+        ring = ring_attention(
+            mesh, causal=True, head_axis=mesh_lib.MODEL_AXIS
+        )
 
     def block(x, p):
         # attention sublayer
@@ -138,19 +208,30 @@ def transformer_apply(cfg: TransformerConfig):
         qkv = jnp.einsum(
             "btd,dshk->sbthk", h_in, p["wqkv"].astype(x.dtype)
         )
-        o = attention(qkv[0], qkv[1], qkv[2], causal=True)
+        if cfg.sequence_parallel:
+            o = ring(qkv[0], qkv[1], qkv[2])
+        else:
+            o = attention(qkv[0], qkv[1], qkv[2], causal=True)
         x = x + jnp.einsum("bthk,hkd->btd", o, p["wo"].astype(x.dtype))
-        # mlp sublayer
+        # ffn sublayer: dense MLP or routed MoE
         h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
-        h = jax.nn.gelu(
-            jnp.einsum("btd,df->btf", h_in, p["w1"].astype(x.dtype))
-            + p["b1"].astype(x.dtype)
-        )
-        x = x + (
-            jnp.einsum("btf,fd->btd", h, p["w2"].astype(x.dtype))
-            + p["b2"].astype(x.dtype)
-        )
-        return x, None
+        if cfg.n_experts:
+            moe_params = jax.tree.map(
+                lambda a: a.astype(x.dtype), p["moe"]
+            )
+            y, aux = moe(moe_params, h_in)
+            x = x + y
+        else:
+            h = jax.nn.gelu(
+                jnp.einsum("btd,df->btf", h_in, p["w1"].astype(x.dtype))
+                + p["b1"].astype(x.dtype)
+            )
+            x = x + (
+                jnp.einsum("btf,fd->btd", h, p["w2"].astype(x.dtype))
+                + p["b2"].astype(x.dtype)
+            )
+            aux = jnp.zeros((), x.dtype)
+        return x, aux
 
     body = jax.checkpoint(block) if cfg.remat else block
 
@@ -158,25 +239,43 @@ def transformer_apply(cfg: TransformerConfig):
         b, t = tokens.shape
         x = params["embed"][tokens] + params["pos"][:t]
         x = x.astype(cfg.compute_dtype)
-        x, _ = lax.scan(body, x, params["blocks"])
+        x, aux = lax.scan(body, x, params["blocks"])
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
         # logits in f32 for a stable softmax
-        return jnp.einsum(
+        logits = jnp.einsum(
             "btd,dv->btv", x.astype(jnp.float32), params["head"]
         )
+        return logits, jnp.sum(aux.astype(jnp.float32))
 
     return apply
 
 
-def transformer_loss(cfg: TransformerConfig):
-    """Next-token cross-entropy: loss(params, tokens) with tokens (B, T+1)."""
-    apply = transformer_apply(cfg)
+def transformer_loss(cfg: TransformerConfig, mesh: Mesh | None = None):
+    """Next-token cross-entropy (+ MoE aux term): loss(params, tokens)
+    with tokens (B, T+1)."""
+    apply = transformer_apply(cfg, mesh)
 
-    def loss(params, tokens):
-        logits = apply(params, tokens[:, :-1])
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, tokens[:, 1:]
-        ).mean()
+    if cfg.sequence_parallel:
+        # keep the model's T equal to the (shard-divisible) input length:
+        # feed all T tokens and mask the final position instead of
+        # slicing the sequence-sharded axis to an uneven T-1
+        def loss(params, tokens):
+            b, t = tokens.shape
+            logits, aux = apply(params, tokens)
+            targets = jnp.roll(tokens, -1, axis=1)
+            ce_tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
+            mask = (jnp.arange(t) < t - 1).astype(ce_tok.dtype)[None, :]
+            ce = jnp.sum(ce_tok * mask) / (jnp.sum(mask) * b)
+            return ce + cfg.aux_coef * aux
+    else:
+        def loss(params, tokens):
+            logits, aux = apply(params, tokens[:, :-1])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tokens[:, 1:]
+            ).mean()
+            return ce + cfg.aux_coef * aux
 
     return loss
 
@@ -192,9 +291,14 @@ def transformer_train_step(
     their outputs with the right shardings.
     """
     optimizer = optimizer or optax.adamw(3e-4)
-    loss_fn = transformer_loss(cfg)
-    shardings = transformer_shardings(mesh)
-    batch_sh = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None))
+    loss_fn = transformer_loss(cfg, mesh)
+    shardings = transformer_shardings(mesh, cfg)
+    batch_sh = NamedSharding(
+        mesh,
+        P(None, mesh_lib.DATA_AXIS)
+        if cfg.sequence_parallel
+        else P(mesh_lib.DATA_AXIS, None),
+    )
 
     def init_state(key):
         params = jax.tree.map(

@@ -125,6 +125,51 @@ def _top_k_dispatch(gates, k: int, capacity: int):
     return dispatch.astype(dt), combine.astype(dt), route_frac.astype(dt)
 
 
+def _moe_core(params: MoEParams, x, *, axis, n_exp, k, capacity_factor,
+              activation):
+    """Per-device routed-FFN body: x (T_local, D) -> (y, local aux loss).
+
+    Runs inside shard_map; ``axis`` names the mesh axis the experts (and
+    the two all-to-alls) live on.
+    """
+    if params.w1.shape[0] != 1:
+        raise ValueError(
+            f"MoE assumes one expert per device: num_experts must equal "
+            f"the mesh's {axis!r} size ({n_exp}), got a per-device "
+            f"block of {params.w1.shape[0]}"
+        )
+    t_local, d = x.shape
+    capacity = max(1, int(capacity_factor * k * t_local / n_exp))
+    gates = jax.nn.softmax(x @ params.wg, axis=-1)  # (T, E)
+    dispatch, combine, route_frac = _top_k_dispatch(gates, k, capacity)
+    # Switch aux loss E * sum_e(f_e * P_e) on the pre-capacity routed
+    # fractions (caller pmean-averages over the mesh)
+    mean_prob = jnp.mean(gates, axis=0)
+    aux = n_exp * jnp.sum(route_frac * mean_prob)
+
+    # dispatch: (T, D) x (T, E, C) -> (E, C, D), then one all-to-all so
+    # device e holds every source shard's bucket for expert e
+    buckets = jnp.einsum("td,tec->ecd", x, dispatch)
+    buckets = lax.all_to_all(
+        buckets, axis, split_axis=0, concat_axis=0, tiled=True
+    )  # (E_src, C, D) on the device owning this expert
+    h = activation(
+        jnp.einsum("scd,dh->sch", buckets, params.w1[0]) + params.b1[0]
+    )
+    out = jnp.einsum("sch,hd->scd", h, params.w2[0]) + params.b2[0]
+    # return trip + weighted combine back to token order (combine is
+    # zero on unoccupied capacity slots, so padding never leaks)
+    out = lax.all_to_all(
+        out, axis, split_axis=0, concat_axis=0, tiled=True
+    )  # (E, C, D) indexed by expert again
+    y = jnp.einsum("ecd,tec->td", out, combine)
+    return y, aux
+
+
+def _param_specs(axis):
+    return MoEParams(P(), P(axis), P(axis), P(axis), P(axis))
+
+
 def moe_apply(mesh, *, k: int = 2, capacity_factor: float = 2.0,
               activation=jax.nn.relu):
     """Build the jitted EP MoE forward: fn(params, x) -> (y, aux_loss).
@@ -137,54 +182,77 @@ def moe_apply(mesh, *, k: int = 2, capacity_factor: float = 2.0,
     n_exp = mesh.shape[AXIS]
 
     def per_device(params: MoEParams, x):
-        # x: (T_local, D); expert tensors carry local slice (1, ...)
-        if params.w1.shape[0] != 1:
-            raise ValueError(
-                f"moe_apply assumes one expert per device: num_experts must "
-                f"equal the mesh's {AXIS!r} size ({n_exp}), got a per-device "
-                f"block of {params.w1.shape[0]}"
-            )
-        t_local, d = x.shape
-        capacity = max(1, int(capacity_factor * k * t_local / n_exp))
-        gates = jax.nn.softmax(x @ params.wg, axis=-1)  # (T, E)
-        dispatch, combine, route_frac = _top_k_dispatch(gates, k, capacity)
-        # Switch aux loss E * sum_e(f_e * P_e) on the pre-capacity routed
-        # fractions, averaged over the mesh
-        mean_prob = jnp.mean(gates, axis=0)
-        aux = n_exp * jnp.sum(route_frac * mean_prob)
-        aux = lax.pmean(aux, AXIS)
-
-        # dispatch: (T, D) x (T, E, C) -> (E, C, D), then one all-to-all so
-        # device e holds every source shard's bucket for expert e
-        buckets = jnp.einsum("td,tec->ecd", x, dispatch)
-        buckets = lax.all_to_all(
-            buckets, AXIS, split_axis=0, concat_axis=0, tiled=True
-        )  # (E_src, C, D) on the device owning this expert
-        w1 = params.w1[0]
-        w2 = params.w2[0]
-        h = activation(
-            jnp.einsum("scd,dh->sch", buckets, w1) + params.b1[0]
+        y, aux = _moe_core(
+            params, x, axis=AXIS, n_exp=n_exp, k=k,
+            capacity_factor=capacity_factor, activation=activation,
         )
-        out = jnp.einsum("sch,hd->scd", h, w2) + params.b2[0]
-        # return trip + weighted combine back to token order (combine is
-        # zero on unoccupied capacity slots, so padding never leaks)
-        out = lax.all_to_all(
-            out, AXIS, split_axis=0, concat_axis=0, tiled=True
-        )  # (E, C, D) indexed by expert again
-        y = jnp.einsum("ecd,tec->td", out, combine)
-        return y, aux
+        return y, lax.pmean(aux, AXIS)
 
     fn = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(
-            MoEParams(P(), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
-            P(AXIS),
-        ),
+        in_specs=(_param_specs(AXIS), P(AXIS)),
         out_specs=(P(AXIS), P()),
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+def moe_ffn(mesh, *, expert_axis=None, token_spec=None, k: int = 2,
+            capacity_factor: float = 2.0, activation=jax.nn.gelu):
+    """MoE FFN over (B, T, D) activations, for use *inside* a jitted model
+    on a multi-axis mesh (e.g. the transformer's (data, model) mesh with
+    experts on the model axis and the batch data-sharded).
+
+    Tokens are *replicated* over the expert axis in this layout (the
+    transformer TP stack keeps activations unsharded on the model axis),
+    so unlike :func:`moe_apply` there is nothing to all-to-all: every
+    device routes the full local token set, applies only its *own*
+    expert to that expert's capacity bucket, and one ``psum`` over the
+    expert axis sums the per-expert partial outputs. FFN FLOPs per
+    device are 1/E of the total — true expert-parallel scaling.
+
+    Returns ``fn(params, x) -> (y, aux)`` (not jitted — call it inside
+    the surrounding jit).
+    """
+    axis = expert_axis or mesh_lib.MODEL_AXIS
+    n_exp = mesh.shape[axis]
+    token_spec = token_spec or P(mesh_lib.DATA_AXIS, None, None)
+
+    def per_device(params: MoEParams, x):
+        if params.w1.shape[0] != 1:
+            raise ValueError(
+                f"MoE assumes one expert per device: num_experts must "
+                f"equal the mesh's {axis!r} size ({n_exp}), got a "
+                f"per-device block of {params.w1.shape[0]}"
+            )
+        b, t, d = x.shape
+        xt = x.reshape(b * t, d)
+        capacity = max(1, int(capacity_factor * k * b * t / n_exp))
+        gates = jax.nn.softmax(xt @ params.wg, axis=-1)
+        dispatch, combine, route_frac = _top_k_dispatch(gates, k, capacity)
+        aux = n_exp * jnp.sum(route_frac * jnp.mean(gates, axis=0))
+        # this device's expert only: slice its dispatch/combine columns
+        e = lax.axis_index(axis)
+        d_e = lax.dynamic_index_in_dim(dispatch, e, axis=1, keepdims=False)
+        c_e = lax.dynamic_index_in_dim(combine, e, axis=1, keepdims=False)
+        bucket = jnp.einsum("td,tc->cd", xt, d_e)  # (C, D)
+        h = activation(bucket @ params.w1[0] + params.b1[0])
+        out = h @ params.w2[0] + params.b2[0]  # (C, D)
+        y = jnp.einsum("cd,tc->td", out, c_e)  # this expert's share
+        y = lax.psum(y, axis)
+        return (
+            y.reshape(b, t, d),
+            lax.pmean(aux, tuple(mesh.axis_names)),
+        )
+
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(_param_specs(axis), token_spec),
+        out_specs=(token_spec, P()),
+        check_vma=False,
+    )
 
 
 def moe_reference(params: MoEParams, x, *, k: int = 2,

@@ -33,14 +33,18 @@ from deeplearning4j_tpu.ops.attention import (
 from deeplearning4j_tpu.parallel import mesh as mesh_lib
 
 
-def ring_attention(mesh, causal: bool = False):
+def ring_attention(mesh, causal: bool = False, head_axis: str | None = None):
     """Build a jitted ring-attention fn over the mesh's data axis.
 
     Returns ``fn(q, k, v) -> out`` where q/k/v are (B, T, H, D) with T
     sharded over the axis.  Exact (not approximate) attention.
+
+    ``head_axis`` optionally names a second mesh axis the head dim stays
+    sharded on (tensor parallelism): the sequence ring then runs within
+    each head-shard subgroup, composing SP x TP without gathering heads.
     """
     axis = mesh_lib.DATA_AXIS
-    n = mesh.devices.size
+    n = mesh.shape[axis]
 
     def per_device(q, k, v):
         # block shapes: (B, T/n, H, D)
@@ -71,7 +75,7 @@ def ring_attention(mesh, causal: bool = False):
         m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v))
         return finalize_online_softmax(l, o)
 
-    seq = P(None, axis, None, None)
+    seq = P(None, axis, head_axis, None)
     fn = shard_map(
         per_device, mesh=mesh, in_specs=(seq, seq, seq), out_specs=seq,
         check_vma=False,
