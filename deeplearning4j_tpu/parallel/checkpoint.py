@@ -126,3 +126,70 @@ class CheckpointManager:
         if s is None:
             return None
         return restore(self.directory / f"ckpt_{s}.npz", like)
+
+
+class AsyncShardedCheckpointManager:
+    """Orbax-backed manager for sharded params — the multi-host path.
+
+    Where the npz ``CheckpointManager`` gathers everything to one host
+    (fine for reference-parity models), this one is built for the SPMD
+    regime the npz path can't reach: every process writes only the param
+    shards it owns (no host gather, multi-host safe), saves run *async*
+    so the next training step overlaps the write, and restore lays
+    arrays back out with the live shardings of the ``like`` tree.
+
+    Same maybe_save/restore_latest surface as ``CheckpointManager`` so
+    trainers can swap backends.
+    """
+
+    def __init__(self, directory: str | Path, keep: int = 3,
+                 save_every: int = 1):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                save_interval_steps=save_every,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def maybe_save(self, step: int, params: Any,
+                   meta: dict | None = None) -> bool:
+        """Queue an async save (returns False when skipped by cadence)."""
+        ocp = self._ocp
+        return self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(params),
+                meta=ocp.args.JsonSave({**(meta or {}), "step": step}),
+            ),
+        )
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable."""
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore_latest(self, like: Any) -> tuple[Any, dict] | None:
+        s = self.latest_step()
+        if s is None:
+            return None
+        ocp = self._ocp
+        out = self._mngr.restore(
+            s,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(like),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        return out["state"], dict(out["meta"])
+
+    def close(self) -> None:
+        self._mngr.close()

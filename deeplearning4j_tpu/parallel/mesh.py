@@ -56,6 +56,51 @@ def expert_mesh(n_devices: int | None = None) -> Mesh:
     return _mesh_1d(EXPERT_AXIS, n_devices)
 
 
+def hybrid_mesh(ici: dict[str, int], dcn: dict[str, int] | None = None) -> Mesh:
+    """Multi-slice mesh: per-axis size = ici[axis] * dcn.get(axis, 1).
+
+    On a multi-slice deployment (TPU pods joined over the data-center
+    network), devices are laid out so the ``dcn`` factor of each axis
+    crosses slices and the ``ici`` factor stays within a slice — e.g.
+    ``hybrid_mesh({"data": 4, "model": 2}, dcn={"data": 2})`` puts data
+    parallelism's outer factor on DCN (cheap AllReduce of gradients once
+    per step) and keeps model parallelism's chatty collectives on ICI.
+    Single-slice environments (including the virtual-device CPU test
+    mesh) collapse to a plain device mesh with the same axis names and
+    sizes, so code written against the hybrid layout runs anywhere.
+    """
+    names = tuple(ici.keys())
+    unknown = set(dcn or {}) - set(names)
+    if unknown:
+        raise ValueError(
+            f"dcn axes {sorted(unknown)} not present in ici axes {names}"
+        )
+    ici_shape = tuple(ici.values())
+    dcn_shape = tuple((dcn or {}).get(k, 1) for k in names)
+    total = [i * d for i, d in zip(ici_shape, dcn_shape)]
+    devs = jax.devices()
+    n = int(np.prod(total))
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices for mesh {dict(zip(names, total))}, have {len(devs)}")
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if any(d > 1 for d in dcn_shape) and n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        # hybrid layout groups devices by slice: the ici product must
+        # consume each slice exactly, so the mesh must use every device
+        if len(devs) != n:
+            raise ValueError(
+                f"hybrid mesh {dict(zip(names, total))} must use all "
+                f"{len(devs)} devices (got a product of {n})"
+            )
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devs
+        )
+    else:
+        arr = np.array(devs[:n]).reshape(total)
+    return Mesh(arr, names)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (batch) axis over the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS))
