@@ -14,6 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu import dtypes
 from deeplearning4j_tpu.nn import activations, losses
 from deeplearning4j_tpu.nn.conf import LayerConfig
 from deeplearning4j_tpu.nn.layers import api
@@ -46,6 +47,10 @@ class OutputLayer(DenseLayer):
         """Mean loss + L2 (≙ OutputLayer.score:60 via LossFunctions.score)."""
         x = api.apply_dropout(x, conf, key, training)
         logits = self.pre_output(params, conf, x)
+        # mixed-precision discipline: matmuls/convs may run bf16 for the
+        # MXU, but softmax/log/loss reductions run in the accumulation
+        # dtype — bf16 log-probabilities stall training on deeper nets
+        logits = logits.astype(dtypes.get_policy().accum_dtype)
         pair = (conf.activation, conf.loss.upper())
         if pair in _FUSED:
             loss = losses.logits_loss(conf.loss, labels, logits)

@@ -138,8 +138,12 @@ class AsyncShardedCheckpointManager:
     so the next training step overlaps the write, and restore lays
     arrays back out with the live shardings of the ``like`` tree.
 
-    Same maybe_save/restore_latest surface as ``CheckpointManager`` so
-    trainers can swap backends.
+    Same method *names* as ``CheckpointManager``, with two contract
+    differences a swapping trainer must respect: ``maybe_save`` returns
+    bool (queued?) rather than a Path, and because saves are async the
+    trainer MUST call ``wait()`` (or ``close()``) before exiting, or
+    in-flight checkpoints are lost and ``restore_latest`` resumes from
+    an older step than the trainer believes it saved.
     """
 
     def __init__(self, directory: str | Path, keep: int = 3,

@@ -67,6 +67,36 @@ def test_mixed_bf16_policy_forward():
     assert np.isfinite(np.asarray(out, np.float32)).all()
 
 
+def test_mixed_bf16_loss_runs_in_accum_dtype():
+    # softmax/log/loss must run f32 under MIXED_BF16 — bf16
+    # log-probabilities stall training on deeper nets (seen on AlexNet)
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import conf as C
+
+    with dtypes.policy(dtypes.MIXED_BF16):
+        mc = C.list_builder(
+            C.LayerConfig(activation="relu"), sizes=[16], n_in=8, n_out=3,
+            pretrain=False, backward=True,
+        )
+        net = MultiLayerNetwork(mc, seed=0)
+        params = net.init()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+        y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+        score = net.supervised_score_fn(params, x, y)
+        assert score.dtype == jnp.float32
+        # training still converges under the mixed policy
+        trainer = DataParallelTrainer(
+            lambda p, xx, yy, key=None: net.supervised_score_fn(p, xx, yy),
+            mesh=data_parallel_mesh(8),
+        )
+        state = trainer.init(params)
+        xs, ys = trainer.shard_batch(x, y)
+        state, losses = trainer.run_steps(state, xs, ys, jax.random.key(0), 60)
+        l = np.asarray(losses)
+        assert np.isfinite(l).all() and l[-1] < l[0] * 0.5
+
+
 def test_graft_dryrun_multichip(devices):
     import importlib.util
 
