@@ -93,7 +93,9 @@ def cmd_train(args) -> int:
 def cmd_bench(args) -> int:
     import bench
 
-    bench.main()
+    # bench has its own argparse; forward only the args meant for it
+    # (sys.argv still holds this CLI's "bench" subcommand)
+    bench.main(list(getattr(args, "bench_args", []) or []))
     return 0
 
 
@@ -127,7 +129,9 @@ def main(argv: list[str] | None = None) -> int:
     _add_distributed_flags(t)
     t.set_defaults(fn=cmd_train)
 
-    b = sub.add_parser("bench", help="run the benchmark harness")
+    b = sub.add_parser("bench", help="run the benchmark harness "
+                       "(unrecognized flags are forwarded to bench.py, "
+                       "e.g. --model alexnet)")
     b.set_defaults(fn=cmd_bench)
 
     s = sub.add_parser("status", help="query a running trainer's REST status")
@@ -140,7 +144,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--zone", default="us-central1-a")
     p.set_defaults(fn=cmd_provision)
 
-    args = parser.parse_args(argv)
+    effective = argv if argv is not None else sys.argv[1:]
+    if effective[:1] == ["bench"]:
+        # bench owns its flags (--model/--batch/--dtype): parse only the
+        # subcommand here and forward the rest verbatim
+        args, extra = parser.parse_known_args(argv)
+        args.bench_args = extra
+    else:
+        args = parser.parse_args(argv)
     return args.fn(args)
 
 

@@ -280,6 +280,129 @@ def transformer_loss(cfg: TransformerConfig, mesh: Mesh | None = None):
     return loss
 
 
+def transformer_generate(cfg: TransformerConfig):
+    """Autoregressive sampling with a per-layer KV cache.
+
+    ≙ the reference's LSTM sampling/beam decode capability
+    (models/classifiers/lstm/LSTM.java:219,241) at the transformer level.
+    Returns ``generate(params, prompt, key, max_new, temperature, top_k)
+    -> tokens (B, Tp + max_new)``; the whole decode (prefill + sampling)
+    is two ``lax.scan``s inside one jittable function. ``temperature=0``
+    decodes greedily. MoE configs decode through the dense per-token
+    routing (generation is single-chip; capacity buffers are pointless
+    at T=1).
+    """
+
+    def block_decode(x, p, ck, cv, pos):
+        # x: (B, D) one position; ck/cv: (B, L, H, K) this layer's cache
+        h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+        qkv = jnp.einsum("bd,dshk->sbhk", h_in, p["wqkv"].astype(x.dtype))
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        ck = lax.dynamic_update_slice(ck, k[:, None], (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v[:, None], (0, pos, 0, 0))
+        d = q.shape[-1]
+        logits = jnp.einsum("bhk,bthk->bht", q, ck) / jnp.sqrt(d).astype(
+            x.dtype
+        )
+        mask = (jnp.arange(ck.shape[1]) <= pos)[None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bht,bthk->bhk", w, cv)
+        x = x + jnp.einsum("bhk,hkd->bd", o, p["wo"].astype(x.dtype))
+        h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+        if cfg.n_experts:
+            from deeplearning4j_tpu.parallel.expert_parallel import (
+                moe_reference,
+            )
+
+            moe_params = jax.tree.map(
+                lambda a: a.astype(x.dtype), p["moe"]
+            )
+            # activation must match moe_ffn's (gelu), or decode runs a
+            # different model than was trained
+            x = x + moe_reference(
+                moe_params, h_in, k=cfg.moe_k, activation=jax.nn.gelu
+            )
+        else:
+            h = jax.nn.gelu(
+                h_in @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype)
+            )
+            x = x + h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype)
+        return x, ck, cv
+
+    def forward_one(params, caches, token, pos):
+        """One position through all layers; returns (logits, caches)."""
+        ck_all, cv_all = caches
+        x = (params["embed"][token] + params["pos"][pos]).astype(
+            cfg.compute_dtype
+        )
+
+        def layer(x, xs):
+            p, ck, cv = xs
+            x, ck, cv = block_decode(x, p, ck, cv, pos)
+            return x, (ck, cv)
+
+        x, (ck_all, cv_all) = lax.scan(
+            layer, x, (params["blocks"], ck_all, cv_all)
+        )
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+        logits = x.astype(jnp.float32) @ params["head"]
+        return logits, (ck_all, cv_all)
+
+    def generate(params, prompt, key, max_new: int,
+                 temperature: float = 1.0, top_k: int | None = None):
+        b, tp = prompt.shape
+        total = tp + max_new
+        if total > cfg.max_len:
+            raise ValueError(
+                f"prompt+max_new ({total}) exceeds max_len ({cfg.max_len})"
+            )
+        nl, h, kd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        # size caches (and thus every step's attention span) to the
+        # actual decode length, not max_len
+        caches = (
+            jnp.zeros((nl, b, total, h, kd), cfg.compute_dtype),
+            jnp.zeros((nl, b, total, h, kd), cfg.compute_dtype),
+        )
+
+        # prefill: walk the prompt, building caches (logits discarded
+        # except the last position's, which seeds sampling)
+        def prefill(carry, pos):
+            caches, _ = carry
+            logits, caches = forward_one(params, caches, prompt[:, pos], pos)
+            return (caches, logits), None
+
+        (caches, logits), _ = lax.scan(
+            prefill,
+            (caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
+            jnp.arange(tp),
+        )
+
+        def sample(logits, key):
+            if top_k is not None:
+                kth = lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if temperature == 0:
+                return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+            return jax.random.categorical(
+                key, logits / temperature, axis=-1
+            ).astype(prompt.dtype)
+
+        def step(carry, i):
+            caches, logits, key = carry
+            key, sub = jax.random.split(key)
+            tok = sample(logits, sub)
+            logits, caches = forward_one(params, caches, tok, tp + i)
+            return (caches, logits, key), tok
+
+        (_, _, _), new_tokens = lax.scan(
+            step, (caches, logits, key), jnp.arange(max_new)
+        )
+        return jnp.concatenate([prompt, new_tokens.T], axis=1)
+
+    return generate
+
+
 def transformer_train_step(
     mesh: Mesh, cfg: TransformerConfig, optimizer=None
 ):

@@ -123,3 +123,19 @@ def test_sentiwordnet_file_loader(tmp_path):
     s = SentiWordNet.from_sentiwordnet_file(f)
     assert s.lexicon["good"] == pytest.approx(0.75)
     assert s.lexicon["bad"] == pytest.approx(-0.875)
+
+
+def test_string_utils_edit_distance_and_lcs():
+    from deeplearning4j_tpu.utils.string_utils import (
+        edit_distance,
+        longest_common_substring,
+        ngrams,
+    )
+
+    assert edit_distance("kitten", "sitting") == 3
+    assert edit_distance("", "abc") == 3
+    assert edit_distance("same", "same") == 0
+    assert longest_common_substring("deeplearning", "earnings") == "earning"
+    assert longest_common_substring("abc", "xyz") == ""
+    assert ngrams(["a", "b", "c"], 2) == [("a", "b"), ("b", "c")]
+    assert ngrams(["a"], 2) == []
