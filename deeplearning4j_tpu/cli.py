@@ -129,8 +129,11 @@ def main(argv: list[str] | None = None) -> int:
     _add_distributed_flags(t)
     t.set_defaults(fn=cmd_train)
 
-    b = sub.add_parser("bench", help="run the benchmark harness "
-                       "(unrecognized flags are forwarded to bench.py, "
+    # add_help=False so `bench -h` reaches bench.py's parser, which
+    # documents --model/--batch/--dtype
+    b = sub.add_parser("bench", add_help=False,
+                       help="run the benchmark harness "
+                       "(flags are forwarded to bench.py, "
                        "e.g. --model alexnet)")
     b.set_defaults(fn=cmd_bench)
 

@@ -25,6 +25,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -403,19 +404,52 @@ def transformer_generate(cfg: TransformerConfig):
     return generate
 
 
+def fsdp_shardings(mesh: Mesh, cfg: TransformerConfig):
+    """ZeRO-3-style augmentation of the TP layout: additionally shard
+    each large param leaf over the *data* axis (first dim that the data
+    axis divides and that isn't already sharded), so params — and the
+    optimizer state, which mirrors them — consume 1/dp of the HBM per
+    device. XLA inserts the all-gathers at use sites and reduce-scatters
+    the matching gradient shards; nothing is hand-scheduled.
+    """
+    dp = mesh.shape[mesh_lib.DATA_AXIS]
+    base = transformer_shardings(mesh, cfg)
+    shapes = jax.eval_shape(
+        lambda: init_transformer(jax.random.key(0), cfg)
+    )
+
+    def augment(sharding, shape):
+        spec = list(sharding.spec) + [None] * (
+            len(shape.shape) - len(sharding.spec)
+        )
+        if int(np.prod(shape.shape)) < 2 * dp:
+            return sharding  # tiny leaf: replication is cheaper
+        for i, (dim, s) in enumerate(zip(shape.shape, spec)):
+            if s is None and dim % dp == 0 and dim >= dp:
+                spec[i] = mesh_lib.DATA_AXIS
+                return NamedSharding(mesh, P(*spec))
+        return sharding
+
+    return jax.tree.map(augment, base, shapes)
+
+
 def transformer_train_step(
-    mesh: Mesh, cfg: TransformerConfig, optimizer=None
+    mesh: Mesh, cfg: TransformerConfig, optimizer=None, fsdp: bool = False
 ):
     """Jitted composed dp x tp train step over a 2-D (data, model) mesh.
 
     Returns ``(step, init_state, shard_tokens)``:
     ``step(params, opt_state, tokens) -> (params, opt_state, loss)`` with
     params TP-sharded, tokens batch-sharded; both factory helpers place
-    their outputs with the right shardings.
+    their outputs with the right shardings. ``fsdp=True`` additionally
+    shards params/optimizer state over the data axis (ZeRO-3 layout via
+    :func:`fsdp_shardings`).
     """
     optimizer = optimizer or optax.adamw(3e-4)
     loss_fn = transformer_loss(cfg, mesh)
-    shardings = transformer_shardings(mesh, cfg)
+    shardings = (
+        fsdp_shardings(mesh, cfg) if fsdp else transformer_shardings(mesh, cfg)
+    )
     batch_sh = NamedSharding(
         mesh,
         P(None, mesh_lib.DATA_AXIS)

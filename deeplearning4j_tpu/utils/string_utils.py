@@ -2,7 +2,7 @@
 
 ≙ the useful surface of the reference's vendored Berkeley
 ``StringUtils`` (berkeley/StringUtils.java, ~1040 LoC): edit distance,
-n-gram/sliding helpers, join/pad. The bulk of the Java file (argmax
+LCS, n-gram helpers. The bulk of the Java file (join/pad, argmax
 maps, reflection helpers, CSV escaping) is stdlib Python
 (str methods, csv, itertools) and is deliberately not re-implemented;
 likewise berkeley ``PriorityQueue``/``Pair``/``Triple``/``Iterators``

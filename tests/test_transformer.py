@@ -146,6 +146,33 @@ def test_sp_moe_composed_train_step(devices):
         assert np.isfinite(float(l))
 
 
+def test_fsdp_training_matches_replicated(devices):
+    # ZeRO-3 layout: params + optimizer state sharded over the data axis;
+    # must train identically (up to reduction reorder) to the plain layout
+    from deeplearning4j_tpu.models.transformer import fsdp_shardings
+
+    mesh = mesh_lib.dp_mp_mesh(2, 4)
+    toks = _tokens(8, 17, seed=30)
+    losses = {}
+    for fsdp in (False, True):
+        step, init_state, shard_tokens = transformer_train_step(
+            mesh, CFG, fsdp=fsdp
+        )
+        params, opt_state = init_state(jax.random.key(30))
+        ts = shard_tokens(toks)
+        ls = []
+        for _ in range(10):
+            params, opt_state, l = step(params, opt_state, ts)
+            ls.append(float(l))
+        losses[fsdp] = ls
+        if fsdp:
+            # the big leaves must actually be data-sharded
+            sh = fsdp_shardings(mesh, CFG)
+            assert "data" in str(sh["embed"].spec)
+            assert "data" in str(sh["blocks"]["wqkv"].spec)
+    np.testing.assert_allclose(losses[False], losses[True], rtol=2e-3)
+
+
 def test_greedy_generate_matches_full_forward():
     from deeplearning4j_tpu.models.transformer import transformer_generate
 
@@ -178,6 +205,13 @@ def test_sampled_generate_is_deterministic_per_key_and_respects_top_k():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert (np.asarray(a) != np.asarray(c)).any()
     assert np.asarray(a).max() < CFG.vocab_size
+    # top_k=1 collapses sampling to greedy regardless of key — this fails
+    # if the top-k filter is inverted or dropped
+    g1 = gen(params, prompt, jax.random.key(3), 8, temperature=1.0, top_k=1)
+    g2 = gen(params, prompt, jax.random.key(4), 8, temperature=1.0, top_k=1)
+    greedy = gen(params, prompt, jax.random.key(5), 8, temperature=0)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(greedy))
 
 
 def test_moe_generate_matches_full_forward(devices):
