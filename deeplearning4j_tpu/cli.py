@@ -27,6 +27,145 @@ def _add_distributed_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--process-id", type=int, default=None)
 
 
+def _train_transformer(args) -> int:
+    """Byte-level char-LM training for the flagship transformer: composed
+    dp x tp mesh (``--tp``), optional MoE experts / FSDP, checkpointing via
+    the npz or orbax backend, and a sampled continuation at the end."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pathlib import Path
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        transformer_generate,
+        transformer_train_step,
+    )
+    from deeplearning4j_tpu.parallel import mesh as mesh_lib
+    from deeplearning4j_tpu.parallel.cluster import ClusterService
+
+    tp = max(1, args.tp)
+    if args.d_model % args.n_heads:
+        print(
+            f"--d-model ({args.d_model}) must be divisible by --n-heads "
+            f"({args.n_heads})", file=sys.stderr,
+        )
+        return 2
+    if args.n_heads % tp:
+        print(
+            f"--n-heads ({args.n_heads}) must be divisible by --tp ({tp})",
+            file=sys.stderr,
+        )
+        return 2
+    if args.n_experts and args.n_experts != tp:
+        print(
+            f"--n-experts ({args.n_experts}) must equal --tp ({tp}): "
+            "experts live one-per-device on the model axis",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.text:
+        try:
+            data = Path(args.text).read_bytes()
+        except OSError as e:
+            print(f"cannot read --text corpus: {e}", file=sys.stderr)
+            return 2
+    else:  # offline demo corpus
+        data = (
+            b"the quick brown fox jumps over the lazy dog. "
+            b"pack my box with five dozen liquor jugs. "
+        ) * 300
+    arr = np.frombuffer(data, np.uint8).astype(np.int32)
+    if len(arr) < args.seq_len + 2:
+        print("corpus shorter than --seq-len", file=sys.stderr)
+        return 2
+
+    n_dev = len(jax.devices())
+    dp = max(1, n_dev // tp)
+    mesh = mesh_lib.dp_mp_mesh(dp, tp)
+    cfg = TransformerConfig(
+        vocab_size=256,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        d_ff=4 * args.d_model,
+        max_len=args.seq_len + 1,
+        n_experts=args.n_experts,
+    )
+    step, init_state, shard_tokens = transformer_train_step(
+        mesh, cfg, fsdp=args.fsdp
+    )
+    params, opt_state = init_state(jax.random.key(0))
+
+    mgr = None
+    if args.checkpoint_dir:
+        if args.checkpoint_backend == "orbax":
+            from deeplearning4j_tpu.parallel.checkpoint import (
+                AsyncShardedCheckpointManager,
+            )
+
+            mgr = AsyncShardedCheckpointManager(
+                args.checkpoint_dir, save_every=args.save_every
+            )
+        else:
+            from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+
+            mgr = CheckpointManager(
+                args.checkpoint_dir, save_every=args.save_every
+            )
+
+    svc = ClusterService()
+    if args.status_port is not None:
+        port = svc.start_rest_api(args.status_port)
+        print(f"status REST on http://127.0.0.1:{port}/statetracker")
+    svc.phase = "train"
+
+    rng = np.random.default_rng(0)
+    batch = max(dp, args.batch - args.batch % dp)
+    loss = l = None
+    for i in range(args.steps):
+        starts = rng.integers(0, len(arr) - args.seq_len - 1, batch)
+        toks = np.stack([arr[s : s + args.seq_len + 1] for s in starts])
+        params, opt_state, l = step(
+            params, opt_state, shard_tokens(jnp.asarray(toks))
+        )
+        svc.batches_so_far = i + 1
+        # materialize the loss only on the print/save cadence — a float()
+        # every step would sync the host and defeat async dispatch
+        on_cadence = (i + 1) % 20 == 0 or (
+            mgr is not None and (i + 1) % args.save_every == 0
+        )
+        if on_cadence or i + 1 == args.steps:
+            loss = float(l)
+            if (i + 1) % 20 == 0:
+                print(f"step {i + 1}/{args.steps} loss {loss:.4f}")
+            if svc.report_loss(loss):
+                print("early stop triggered")
+                break
+        if mgr:
+            mgr.maybe_save(i + 1, params, {"loss": loss})
+    if mgr is not None and hasattr(mgr, "wait"):
+        mgr.wait()  # async saves must be durable before exit
+    if loss is None and l is not None:
+        loss = float(l)
+    svc.phase = "done"
+    print(f"final loss {loss:.4f}")
+
+    if cfg.max_len >= 32:
+        gen = transformer_generate(cfg)
+        prompt = jnp.asarray(arr[None, :16])
+        out = gen(
+            jax.device_get(params) if args.fsdp else params,
+            prompt, jax.random.key(1),
+            min(cfg.max_len - 16, 48), temperature=0.8, top_k=40,
+        )
+        text = bytes(np.asarray(out[0], np.uint8).tolist())
+        print("sample:", text.decode("latin-1"))
+    return 0
+
+
 def cmd_train(args) -> int:
     import jax
     import jax.numpy as jnp
@@ -36,6 +175,9 @@ def cmd_train(args) -> int:
         from deeplearning4j_tpu.parallel.cluster import initialize_distributed
 
         initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+
+    if args.model == "transformer":
+        return _train_transformer(args)
 
     from deeplearning4j_tpu.datasets import fetchers
     from deeplearning4j_tpu.parallel import DataParallelTrainer, data_parallel_mesh
@@ -119,13 +261,30 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     t = sub.add_parser("train", help="train a model (single or multi-host SPMD)")
-    t.add_argument("--model", default="lenet", choices=["lenet", "alexnet"])
+    t.add_argument(
+        "--model", default="lenet",
+        choices=["lenet", "alexnet", "transformer"],
+    )
     t.add_argument("--epochs", type=int, default=1)
     t.add_argument("--batch", type=int, default=256)
     t.add_argument("--examples", type=int, default=4096)
     t.add_argument("--checkpoint-dir", default=None)
+    t.add_argument(
+        "--checkpoint-backend", default="npz", choices=["npz", "orbax"],
+        help="orbax = async shard-local writes (transformer only)",
+    )
     t.add_argument("--save-every", type=int, default=50)
     t.add_argument("--status-port", type=int, default=None)
+    # transformer-only knobs
+    t.add_argument("--text", default=None, help="path to a byte-level corpus")
+    t.add_argument("--steps", type=int, default=200)
+    t.add_argument("--seq-len", type=int, default=128)
+    t.add_argument("--d-model", type=int, default=128)
+    t.add_argument("--n-layers", type=int, default=2)
+    t.add_argument("--n-heads", type=int, default=4)
+    t.add_argument("--n-experts", type=int, default=0)
+    t.add_argument("--tp", type=int, default=1, help="tensor-parallel ways")
+    t.add_argument("--fsdp", action="store_true")
     _add_distributed_flags(t)
     t.set_defaults(fn=cmd_train)
 

@@ -80,6 +80,29 @@ def test_cli_train_and_provision(tmp_path, capsys):
     assert "--zone=us-east1-d" in out
 
 
+def test_cli_train_transformer_tp_orbax(tmp_path, capsys):
+    from deeplearning4j_tpu.cli import main
+
+    rc = main(
+        [
+            "train", "--model", "transformer", "--steps", "4",
+            "--seq-len", "32", "--d-model", "32", "--batch", "8",
+            "--tp", "2", "--checkpoint-dir", str(tmp_path / "ck"),
+            "--checkpoint-backend", "orbax", "--save-every", "2",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final loss" in out
+    assert "sample:" in out
+    # orbax checkpoints are durable (wait() ran); orbax always saves the
+    # first step, then follows the save-every cadence
+    steps = sorted(
+        int(p.name) for p in (tmp_path / "ck").iterdir() if p.name.isdigit()
+    )
+    assert steps == [1, 2, 4]
+
+
 def test_cloud_io_local_and_dispatch(tmp_path):
     saver = get_saver(str(tmp_path))
     assert isinstance(saver, LocalModelSaver)
