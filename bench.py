@@ -15,6 +15,7 @@ bench_baseline.json next to this file after the first run on TPU).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import time
 from pathlib import Path
@@ -66,6 +67,11 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", choices=("lenet", "alexnet"), default="lenet")
     ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="capture an XPlane/Perfetto trace of the timed window into "
+        "DIR (view with tensorboard or ui.perfetto.dev)",
+    )
     ap.add_argument(
         "--dtype", choices=("auto", "bf16", "f32"), default="auto",
         help="bf16 = mixed precision (MXU-native compute, f32 params and "
@@ -126,13 +132,20 @@ def main(argv=None) -> None:
     once = time.perf_counter() - t0
     reps = max(1, int(MIN_TIMED_SECONDS / max(once, 1e-6)) + 1)
 
-    t0 = time.perf_counter()
-    for r in range(reps):
-        state, losses = trainer.run_steps(
-            state, x, y, jax.random.key(2 + r), STEPS
-        )
-    drain(losses)
-    dt = time.perf_counter() - t0
+    if args.profile:
+        from deeplearning4j_tpu.utils import profiling
+
+        prof = profiling.trace(args.profile)
+    else:
+        prof = contextlib.nullcontext()
+    with prof:
+        t0 = time.perf_counter()
+        for r in range(reps):
+            state, losses = trainer.run_steps(
+                state, x, y, jax.random.key(2 + r), STEPS
+            )
+        drain(losses)
+        dt = time.perf_counter() - t0
 
     samples_per_sec = args.batch * STEPS * reps / dt
     per_chip = samples_per_sec / n_chips

@@ -71,18 +71,25 @@ class DataParallelTrainer:
         repl = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS))
 
-        def step(state: TrainState, x, y, key):
-            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, x, y, key)
+        def apply_grads(state: TrainState, grads, loss):
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
             return TrainState(params, opt_state, state.step + 1), loss
 
+        def step(state: TrainState, x, y, key):
+            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, x, y, key)
+            return apply_grads(state, grads, loss)
+
+        self._apply_grads = apply_grads
         self._raw_step = step
         self._repl, self._shard = repl, shard
+        self._microbatch_shard = NamedSharding(
+            self.mesh, P(None, mesh_lib.DATA_AXIS)
+        )
         self._donate = donate
-        self._multi_cache: dict[int, Any] = {}
+        self._multi_cache: dict[int | tuple, Any] = {}
         self._epoch_fn = None
         self._step = jax.jit(
             step,
@@ -148,9 +155,7 @@ class DataParallelTrainer:
         data mesh axis — one compiled program per epoch shape.
         """
         if self._epoch_fn is None:
-            batch_shard = NamedSharding(
-                self.mesh, P(None, mesh_lib.DATA_AXIS)
-            )
+            batch_shard = self._microbatch_shard
 
             def epoch(state, xs, ys, key):
                 keys = jax.random.split(key, xs.shape[0])
@@ -167,6 +172,49 @@ class DataParallelTrainer:
                 donate_argnums=(0,) if self._donate else (),
             )
         return self._epoch_fn(state, xs, ys, key)
+
+    def step_accumulate(
+        self, state: TrainState, xs, ys, key
+    ) -> tuple[TrainState, jax.Array]:
+        """One optimizer update from gradients accumulated over the
+        leading microbatch axis of ``xs[n_micro, B, ...]`` — effective
+        batch ``n_micro * B`` with only one microbatch's activations live
+        at a time (the standard big-batch/HBM lever, in-graph as one
+        ``lax.scan``). Returns ``(state, mean_loss)``.
+        """
+        fn = self._multi_cache.get(("accum", xs.shape[0]))
+        if fn is None:
+            batch_shard = self._microbatch_shard
+
+            def accum(state, xs, ys, key):
+                keys = jax.random.split(key, xs.shape[0])
+                zero = jax.tree.map(jnp.zeros_like, state.params)
+
+                def micro(carry, xyk):
+                    g_acc, loss_acc = carry
+                    loss, g = jax.value_and_grad(self.loss_fn)(
+                        state.params, xyk[0], xyk[1], xyk[2]
+                    )
+                    return (
+                        jax.tree.map(jnp.add, g_acc, g),
+                        loss_acc + loss,
+                    ), None
+
+                (g_sum, loss_sum), _ = lax.scan(
+                    micro, (zero, jnp.zeros(())), (xs, ys, keys)
+                )
+                n = xs.shape[0]
+                grads = jax.tree.map(lambda g: g / n, g_sum)
+                return self._apply_grads(state, grads, loss_sum / n)
+
+            fn = jax.jit(
+                accum,
+                in_shardings=(self._repl, batch_shard, batch_shard, self._repl),
+                out_shardings=(self._repl, self._repl),
+                donate_argnums=(0,) if self._donate else (),
+            )
+            self._multi_cache[("accum", xs.shape[0])] = fn
+        return fn(state, xs, ys, key)
 
 
 def local_sgd_step(

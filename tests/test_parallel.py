@@ -222,3 +222,32 @@ def test_lenet_trains_data_parallel(devices):
         if l0 is None:
             l0 = float(l)
     assert float(l) < l0, (l0, float(l))
+
+
+def test_gradient_accumulation_matches_full_batch(devices):
+    """step_accumulate over n microbatches == one step on the concatenated
+    batch (loss is a batch mean, so summed-then-averaged micro-gradients
+    reproduce the full-batch gradient exactly)."""
+    net, params = build_lenet(seed=0)
+    loss = lenet_loss(net)
+    mesh = data_parallel_mesh(8)
+    ds = fetchers.mnist(n=256)
+    x = jnp.asarray(ds.features)
+    y = jnp.asarray(ds.labels)
+
+    t1 = DataParallelTrainer(loss, mesh=mesh, donate=False)
+    s1 = t1.init(params)
+    xs, ys = t1.shard_batch(x, y)
+    s1, l_full = t1.step(s1, xs, ys, jax.random.key(0))
+
+    t2 = DataParallelTrainer(loss, mesh=mesh, donate=False)
+    s2 = t2.init(params)
+    xm = x.reshape(4, 64, -1)
+    ym = y.reshape(4, 64, -1)
+    s2, l_acc = t2.step_accumulate(s2, xm, ym, jax.random.key(0))
+
+    np.testing.assert_allclose(float(l_full), float(l_acc), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        )
