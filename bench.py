@@ -33,6 +33,83 @@ MIN_TIMED_SECONDS = 1.0  # repeat the scanned program until the window is
 # long enough that dispatch overhead and timer noise are negligible
 
 
+def _run_window(args, run, drain) -> tuple[int, float]:
+    """Shared timing harness: warmup, calibrate reps to >= MIN_TIMED_SECONDS,
+    then the (optionally profiled) timed window.
+
+    ``run(i)`` enqueues one unit of work; ``drain()`` forces completion by
+    fetching values to the host — on the tunneled TPU backend
+    block_until_ready returns at enqueue, so a value fetch is the only
+    sync that provably drains the device queue. Returns (reps, seconds).
+    """
+    run(0)
+    drain()
+    t0 = time.perf_counter()
+    run(1)
+    drain()
+    once = time.perf_counter() - t0
+    reps = max(1, int(MIN_TIMED_SECONDS / max(once, 1e-6)) + 1)
+
+    if args.profile:
+        from deeplearning4j_tpu.utils import profiling
+
+        prof = profiling.trace(args.profile)
+    else:
+        prof = contextlib.nullcontext()
+    with prof:
+        t0 = time.perf_counter()
+        for r in range(reps):
+            run(2 + r)
+        drain()
+        dt = time.perf_counter() - t0
+    return reps, dt
+
+
+def _bench_word2vec(args):
+    """Hierarchical-softmax kernel throughput (pairs/sec) — the hot loop
+    the reference spends its NLP time in (InMemoryLookupTable.
+    iterateSample:171-270, BLAS dot+axpy per Huffman bit); here it is the
+    batched scatter-add `_hs_scan`, k folded batches per dispatch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.word2vec import _SCAN_WIDTH, _hs_scan
+
+    batch = args.batch
+    v, d, depth = 10_000, 100, 16
+    rng = np.random.default_rng(0)
+    state = {
+        "syn0": jnp.asarray(rng.normal(0, 0.1, (v, d)).astype(np.float32)),
+        "syn1": jnp.zeros((v, d), jnp.float32),
+    }
+    codes = jnp.asarray(rng.integers(0, 2, (v, depth)).astype(np.float32))
+    points = jnp.asarray(rng.integers(0, v, (v, depth)).astype(np.int32))
+    mask = jnp.asarray(
+        (np.arange(depth)[None, :] < rng.integers(8, depth, (v, 1)))
+        .astype(np.float32)
+    )
+    k = _SCAN_WIDTH
+    lrs = jnp.full((k,), 0.025, jnp.float32)
+    r = np.random.default_rng(1)
+    ins = jnp.asarray(r.integers(0, v, (k, batch)).astype(np.int32))
+    tgts = jnp.asarray(r.integers(0, v, (k, batch)).astype(np.int32))
+
+    def run(_i):
+        state["syn0"], state["syn1"] = _hs_scan(
+            state["syn0"], state["syn1"], ins, tgts, codes, points, mask, lrs
+        )
+
+    def drain():
+        out = np.asarray(state["syn0"][0])
+        assert np.isfinite(out).all(), "w2v bench produced non-finite rows"
+
+    reps, dt = _run_window(args, run, drain)
+    # _hs_scan is a single-device kernel: the per-chip number is the raw
+    # rate, NOT divided by the host's chip count
+    return k * batch * reps / dt, "word2vec_hs_train_pairs_per_sec_per_chip"
+
+
 def _build(model: str, batch: int):
     """(params, loss_fn, x, y, metric_name) for the chosen workload."""
     import jax.numpy as jnp
@@ -65,7 +142,14 @@ def _build(model: str, batch: int):
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", choices=("lenet", "alexnet"), default="lenet")
+    ap.add_argument(
+        "--model", choices=("lenet", "alexnet", "word2vec"), default="lenet"
+    )
+    ap.add_argument(
+        "--scaling", action="store_true",
+        help="measure data-parallel scaling efficiency 1 -> N local chips "
+        "(throughput_N / (N * throughput_1)); 1.0 trivially on one chip",
+    )
     ap.add_argument("--batch", type=int, default=BATCH)
     ap.add_argument(
         "--profile", metavar="DIR", default=None,
@@ -82,7 +166,9 @@ def main(argv=None) -> None:
     )
     args = ap.parse_args(argv)
     if args.dtype == "auto":
-        args.dtype = {"lenet": "f32", "alexnet": "bf16"}[args.model]
+        args.dtype = {
+            "lenet": "f32", "alexnet": "bf16", "word2vec": "f32",
+        }[args.model]
 
     import jax
 
@@ -103,6 +189,29 @@ def main(argv=None) -> None:
         dtypes.set_policy(dtypes.MIXED_BF16)
 
     n_chips = len(jax.devices())
+
+    if args.model == "word2vec":
+        if args.scaling:
+            ap.error("--scaling applies to the trainer workloads, not "
+                     "the single-device word2vec kernel")
+        per_chip, metric = _bench_word2vec(args)
+        _report(args, per_chip, metric, jax)
+        return
+
+    if args.scaling and n_chips == 1:
+        # nothing to compare on one chip — skip the measurement entirely
+        print(
+            json.dumps(
+                {
+                    "metric": f"{args.model}_dp_scaling_efficiency_1_to_1",
+                    "value": 1.0,
+                    "unit": "efficiency",
+                    "vs_baseline": None,
+                }
+            )
+        )
+        return
+
     mesh = mesh_lib.data_parallel_mesh(n_chips)
 
     params, loss, x, y, metric = _build(args.model, args.batch)
@@ -110,46 +219,57 @@ def main(argv=None) -> None:
     state = trainer.init(params)
     x, y = trainer.shard_batch(x, y)
 
-    # one dispatch for the whole measured loop: lax.scan inside jit
-    # (run_steps), so the number reflects device throughput, not Python
-    # launch overhead.  Synchronization note: on the tunneled TPU backend
-    # block_until_ready returns at enqueue, not completion, so every
-    # window below is closed by fetching the loss VALUES to the host —
-    # the only sync that provably drains the device queue.
-    def drain(losses):
-        out = np.asarray(losses)
-        assert np.isfinite(out).all(), "bench produced non-finite loss"
-        return out
+    samples_per_sec = _measure_trainer(args, trainer, state, x, y)
 
-    for i in range(max(1, WARMUP // 10)):
-        state, losses = trainer.run_steps(state, x, y, jax.random.key(i), STEPS)
-    drain(losses)
-
-    # calibrate the repeat count so the timed window is >= MIN_TIMED_SECONDS
-    t0 = time.perf_counter()
-    state, losses = trainer.run_steps(state, x, y, jax.random.key(1), STEPS)
-    drain(losses)
-    once = time.perf_counter() - t0
-    reps = max(1, int(MIN_TIMED_SECONDS / max(once, 1e-6)) + 1)
-
-    if args.profile:
-        from deeplearning4j_tpu.utils import profiling
-
-        prof = profiling.trace(args.profile)
-    else:
-        prof = contextlib.nullcontext()
-    with prof:
-        t0 = time.perf_counter()
-        for r in range(reps):
-            state, losses = trainer.run_steps(
-                state, x, y, jax.random.key(2 + r), STEPS
+    if args.scaling:
+        mesh1 = mesh_lib.data_parallel_mesh(1)
+        params1, loss1, x1, y1, _ = _build(args.model, args.batch)
+        trainer1 = DataParallelTrainer(loss1, mesh=mesh1)
+        state1 = trainer1.init(params1)
+        x1, y1 = trainer1.shard_batch(x1, y1)
+        sps1 = _measure_trainer(args, trainer1, state1, x1, y1)
+        eff = samples_per_sec / (n_chips * sps1)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{args.model}_dp_scaling_efficiency"
+                    f"_1_to_{n_chips}",
+                    "value": round(eff, 4),
+                    "unit": "efficiency",
+                    "vs_baseline": None,
+                }
             )
-        drain(losses)
-        dt = time.perf_counter() - t0
+        )
+        return
 
-    samples_per_sec = args.batch * STEPS * reps / dt
-    per_chip = samples_per_sec / n_chips
+    _report(args, samples_per_sec / n_chips, metric, jax)
 
+
+def _measure_trainer(args, trainer, state, x, y) -> float:
+    """samples/sec over a >= MIN_TIMED_SECONDS window of run_steps calls.
+
+    One dispatch covers the whole scanned loop (run_steps), so the number
+    reflects device throughput, not Python launch overhead.
+    """
+    import jax
+    import numpy as np
+
+    holder = {"state": state, "losses": None}
+
+    def run(i):
+        holder["state"], holder["losses"] = trainer.run_steps(
+            holder["state"], x, y, jax.random.key(i), STEPS
+        )
+
+    def drain():
+        out = np.asarray(holder["losses"])
+        assert np.isfinite(out).all(), "bench produced non-finite loss"
+
+    reps, dt = _run_window(args, run, drain)
+    return args.batch * STEPS * reps / dt
+
+
+def _report(args, per_chip: float, metric: str, jax) -> None:
     platform = jax.devices()[0].platform
     records = (
         json.loads(BASELINE_FILE.read_text()) if BASELINE_FILE.exists() else {}
@@ -179,7 +299,11 @@ def main(argv=None) -> None:
             {
                 "metric": metric,
                 "value": round(per_chip, 1),
-                "unit": "samples/sec/chip",
+                "unit": (
+                    "pairs/sec/chip"
+                    if "pairs" in metric
+                    else "samples/sec/chip"
+                ),
                 "vs_baseline": vs_baseline,
             }
         )

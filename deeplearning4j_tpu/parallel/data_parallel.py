@@ -89,8 +89,9 @@ class DataParallelTrainer:
             self.mesh, P(None, mesh_lib.DATA_AXIS)
         )
         self._donate = donate
-        self._multi_cache: dict[int | tuple, Any] = {}
+        self._multi_cache: dict[int, Any] = {}
         self._epoch_fn = None
+        self._accum_fn = None
         self._step = jax.jit(
             step,
             in_shardings=(repl, shard, shard, repl),
@@ -182,8 +183,7 @@ class DataParallelTrainer:
         at a time (the standard big-batch/HBM lever, in-graph as one
         ``lax.scan``). Returns ``(state, mean_loss)``.
         """
-        fn = self._multi_cache.get(("accum", xs.shape[0]))
-        if fn is None:
+        if self._accum_fn is None:
             batch_shard = self._microbatch_shard
 
             def accum(state, xs, ys, key):
@@ -213,8 +213,8 @@ class DataParallelTrainer:
                 out_shardings=(self._repl, self._repl),
                 donate_argnums=(0,) if self._donate else (),
             )
-            self._multi_cache[("accum", xs.shape[0])] = fn
-        return fn(state, xs, ys, key)
+            self._accum_fn = fn
+        return self._accum_fn(state, xs, ys, key)
 
 
 def local_sgd_step(
