@@ -198,6 +198,10 @@ def main(argv=None) -> None:
         _report(args, per_chip, metric, jax)
         return
 
+    if args.scaling and args.profile:
+        ap.error("--profile with --scaling would mix two traces (N-chip "
+                 "and 1-chip windows) in one dump; profile a plain run")
+
     if args.scaling and n_chips == 1:
         # nothing to compare on one chip — skip the measurement entirely
         print(

@@ -55,6 +55,9 @@ class TransformerConfig:
     # sequence parallelism: shard the sequence over the data axis and run
     # ring attention (heads stay TP-sharded on the model axis)
     sequence_parallel: bool = False
+    # pallas flash-attention kernels (causal, custom-vjp backward, O(T)
+    # memory) in place of dense attention; needs T <= 128 or T % 128 == 0
+    use_flash: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -180,6 +183,12 @@ def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
     """
     if (cfg.n_experts or cfg.sequence_parallel) and mesh is None:
         raise ValueError("MoE / sequence-parallel modes need a mesh")
+    if cfg.use_flash and cfg.sequence_parallel:
+        raise ValueError(
+            "use_flash and sequence_parallel are mutually exclusive: the "
+            "sequence-parallel path attends via the ring, not the local "
+            "flash kernel"
+        )
     if cfg.n_experts:
         if cfg.n_experts != mesh.shape[mesh_lib.MODEL_AXIS]:
             raise ValueError(
@@ -211,6 +220,18 @@ def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
         )
         if cfg.sequence_parallel:
             o = ring(qkv[0], qkv[1], qkv[2])
+        elif cfg.use_flash:
+            from deeplearning4j_tpu.ops.pallas_kernels import (
+                flash_attention_trainable,
+            )
+
+            t = qkv.shape[2]
+            if t > 128 and t % 128:
+                raise ValueError(
+                    f"use_flash needs seq len <= 128 or a multiple of "
+                    f"128, got {t}"
+                )
+            o = flash_attention_trainable(qkv[0], qkv[1], qkv[2], causal=True)
         else:
             o = attention(qkv[0], qkv[1], qkv[2], causal=True)
         x = x + jnp.einsum("bthk,hkd->btd", o, p["wo"].astype(x.dtype))

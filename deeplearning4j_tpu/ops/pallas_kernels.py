@@ -34,8 +34,17 @@ def _on_tpu() -> bool:
 
 # -- flash attention ----------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int, scale: float):
+def _causal_bias(q_start, k_start, block_q: int, block_k: int):
+    """0 where col <= row, -inf above the diagonal (absolute positions)."""
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(cols <= rows, 0.0, -jnp.inf).astype(jnp.float32)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  kv_len: int, scale: float, causal: bool):
     q = q_ref[0]  # (block_q, d)
+    q_start = pl.program_id(1) * block_q
     m = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
     l = jnp.zeros((q.shape[0],), jnp.float32)
     acc = jnp.zeros(q.shape, jnp.float32)
@@ -45,6 +54,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int, scal
         k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :]
         v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = s + _causal_bias(q_start, start * block_k, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
@@ -54,15 +65,23 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, kv_len: int, scal
         )
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, kv_len // block_k, body, (m, l, acc))
+    # causal: blocks entirely above the diagonal contribute nothing — skip
+    n_blocks = (
+        (q_start + block_q + block_k - 1) // block_k
+        if causal
+        else kv_len // block_k
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, kv_len: int, scale: float
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: int,
+    kv_len: int, scale: float, causal: bool
 ):
     """Forward that also writes the per-row logsumexp (for the backward)."""
     q = q_ref[0]
+    q_start = pl.program_id(1) * block_q
     m = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
     l = jnp.zeros((q.shape[0],), jnp.float32)
     acc = jnp.zeros(q.shape, jnp.float32)
@@ -72,6 +91,8 @@ def _flash_fwd_kernel(
         k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :]
         v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = s + _causal_bias(q_start, start * block_k, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
@@ -81,44 +102,61 @@ def _flash_fwd_kernel(
         )
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, kv_len // block_k, body, (m, l, acc))
+    n_blocks = (
+        (q_start + block_q + block_k - 1) // block_k
+        if causal
+        else kv_len // block_k
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+    # lse carried as (bh, t, 1): a 2-D (bh, t) output would need a
+    # (1, block_q) block, which Mosaic rejects (second-to-last dim must
+    # be a multiple of 8 or the full array dim)
+    lse_ref[0, :, 0] = (m + jnp.log(l)).astype(jnp.float32)
 
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k: int, kv_len: int, scale: float,
+    *, block_q: int, block_k: int, kv_len: int, scale: float, causal: bool,
 ):
     """dQ for one Q block: stream K/V blocks, recompute p from the saved
     logsumexp (no T x T materialization)."""
     q = q_ref[0].astype(jnp.float32)
+    q_start = pl.program_id(1) * block_q
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
     dq = jnp.zeros(q.shape, jnp.float32)
 
     def body(start, dq):
         k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = s + _causal_bias(q_start, start * block_k, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32) * scale
 
-    dq = jax.lax.fori_loop(0, kv_len // block_k, body, dq)
+    n_blocks = (
+        (q_start + block_q + block_k - 1) // block_k
+        if causal
+        else kv_len // block_k
+    )
+    dq = jax.lax.fori_loop(0, n_blocks, body, dq)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, q_len: int, scale: float,
+    *, block_q: int, block_k: int, q_len: int, scale: float, causal: bool,
 ):
     """dK/dV for one K/V block: stream Q blocks."""
     k_blk = k_ref[0].astype(jnp.float32)
     v_blk = v_ref[0].astype(jnp.float32)
+    k_start = pl.program_id(1) * block_k
     dk = jnp.zeros(k_blk.shape, jnp.float32)
     dv = jnp.zeros(v_blk.shape, jnp.float32)
 
@@ -126,9 +164,11 @@ def _flash_bwd_dkv_kernel(
         dk, dv = carry
         q = q_ref[0, pl.dslice(start * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.dslice(start * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(start * block_q, block_q)]
-        delta = delta_ref[0, pl.dslice(start * block_q, block_q)]
+        lse = lse_ref[0, pl.dslice(start * block_q, block_q), 0]
+        delta = delta_ref[0, pl.dslice(start * block_q, block_q), 0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = s + _causal_bias(start * block_q, k_start, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
@@ -136,7 +176,9 @@ def _flash_bwd_dkv_kernel(
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
         return dk, dv
 
-    dk, dv = jax.lax.fori_loop(0, q_len // block_q, body, (dk, dv))
+    # causal: q blocks strictly above this K block's diagonal see none of it
+    start0 = k_start // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(start0, q_len // block_q, body, (dk, dv))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -148,6 +190,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    causal: bool = False,
 ) -> jax.Array:
     """(B, T, H, D) attention, pallas-blocked. T must divide by blocks."""
     b, t, h, d = q.shape
@@ -163,7 +206,8 @@ def flash_attention(
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, kv_len=t, scale=scale
+        _flash_kernel, block_q=block_q, block_k=block_k, kv_len=t,
+        scale=scale, causal=causal,
     )
     out = pl.pallas_call(
         kernel,
@@ -181,24 +225,25 @@ def flash_attention(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
-def _flash_bhtd(qf, kf, vf, block_q, block_k, interpret):
-    out, _ = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret)
+def _flash_bhtd(qf, kf, vf, block_q, block_k, interpret, causal):
+    out, _ = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret, causal)
     return out
 
 
-def _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret):
+def _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret, causal):
     bh, t, d = qf.shape
     scale = 1.0 / (d**0.5)
     kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, kv_len=t, scale=scale
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, kv_len=t,
+        scale=scale, causal=causal,
     )
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ),
         grid=(bh, t // block_q),
         in_specs=[
@@ -208,28 +253,32 @@ def _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ),
         interpret=interpret,
     )(qf, kf, vf)
     return out, lse
 
 
-def _flash_fwd_rule(qf, kf, vf, block_q, block_k, interpret):
-    out, lse = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret)
+def _flash_fwd_rule(qf, kf, vf, block_q, block_k, interpret, causal):
+    out, lse = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret, causal)
     return out, (qf, kf, vf, out, lse)
 
 
-def _flash_bwd_rule(block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
     qf, kf, vf, out, lse = res
     bh, t, d = qf.shape
     scale = 1.0 / (d**0.5)
-    # delta_i = <dO_i, O_i> — the softmax normalizer correction
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # delta_i = <dO_i, O_i> — the softmax normalizer correction; kept
+    # (bh, t, 1) for the same Mosaic block-shape rule as lse
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )[..., None]
 
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, block_k=block_k, kv_len=t, scale=scale
+            _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            kv_len=t, scale=scale, causal=causal,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
         grid=(bh, t // block_q),
@@ -238,8 +287,8 @@ def _flash_bwd_rule(block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
@@ -247,7 +296,8 @@ def _flash_bwd_rule(block_q, block_k, interpret, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, block_q=block_q, q_len=t, scale=scale
+            _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            q_len=t, scale=scale, causal=causal,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
@@ -259,8 +309,8 @@ def _flash_bwd_rule(block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, t), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -281,6 +331,7 @@ def flash_attention_trainable(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    causal: bool = False,
 ) -> jax.Array:
     """Differentiable flash attention: (B, T, H, D) in and out.
 
@@ -297,7 +348,7 @@ def flash_attention_trainable(
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    out = _flash_bhtd(qf, kf, vf, block_q, block_k, interpret)
+    out = _flash_bhtd(qf, kf, vf, block_q, block_k, interpret, causal)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 

@@ -49,3 +49,58 @@ def test_flash_attention_trainable_grads_match_dense():
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         assert float(jnp.max(jnp.abs(a - b))) < 1e-3
+
+
+def test_flash_attention_causal_matches_dense():
+    from deeplearning4j_tpu.ops.attention import attention
+    from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+
+    rng = np.random.default_rng(7)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 256, 2, 16)).astype(np.float32))
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, block_q=64, block_k=64, causal=True)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_trainable_causal_grads_match_dense():
+    from deeplearning4j_tpu.ops.attention import attention
+    from deeplearning4j_tpu.ops.pallas_kernels import flash_attention_trainable
+
+    rng = np.random.default_rng(8)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, 128, 2, 8)).astype(np.float32))
+        for _ in range(3)
+    )
+
+    def loss_flash(q, k, v):
+        o = flash_attention_trainable(q, k, v, block_q=32, block_k=32, causal=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_dense(q, k, v):
+        o = attention(q, k, v, causal=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    np.testing.assert_allclose(
+        float(loss_flash(q, k, v)), float(loss_dense(q, k, v)), rtol=1e-5
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_flash_attention_noncausal_unchanged():
+    from deeplearning4j_tpu.ops.attention import attention
+    from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+
+    rng = np.random.default_rng(9)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 128, 2, 16)).astype(np.float32))
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, block_q=64, block_k=64)
+    ref = attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
