@@ -41,6 +41,15 @@ def _causal_bias(q_start, k_start, block_q: int, block_k: int):
     return jnp.where(cols <= rows, 0.0, -jnp.inf).astype(jnp.float32)
 
 
+def _n_kv_blocks(q_start, block_q: int, block_k: int, kv_len: int,
+                 causal: bool):
+    """KV blocks a Q block must visit: all of them, or (causal) only those
+    intersecting the diagonal — shared by forward and dQ kernels."""
+    if not causal:
+        return kv_len // block_k
+    return (q_start + block_q + block_k - 1) // block_k
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
                   kv_len: int, scale: float, causal: bool):
     q = q_ref[0]  # (block_q, d)
@@ -66,11 +75,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
         return m_new, l_new, acc_new
 
     # causal: blocks entirely above the diagonal contribute nothing — skip
-    n_blocks = (
-        (q_start + block_q + block_k - 1) // block_k
-        if causal
-        else kv_len // block_k
-    )
+    n_blocks = _n_kv_blocks(q_start, block_q, block_k, kv_len, causal)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
 
@@ -102,11 +107,7 @@ def _flash_fwd_kernel(
         )
         return m_new, l_new, acc_new
 
-    n_blocks = (
-        (q_start + block_q + block_k - 1) // block_k
-        if causal
-        else kv_len // block_k
-    )
+    n_blocks = _n_kv_blocks(q_start, block_q, block_k, kv_len, causal)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
@@ -140,11 +141,7 @@ def _flash_bwd_dq_kernel(
         ds = p * (dp - delta[:, None])
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32) * scale
 
-    n_blocks = (
-        (q_start + block_q + block_k - 1) // block_k
-        if causal
-        else kv_len // block_k
-    )
+    n_blocks = _n_kv_blocks(q_start, block_q, block_k, kv_len, causal)
     dq = jax.lax.fori_loop(0, n_blocks, body, dq)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
