@@ -33,6 +33,13 @@ def _on_tpu() -> bool:
 
 
 # -- flash attention ----------------------------------------------------------
+#
+# Streamed-grid design: the grid is (batch*heads, q_blocks, kv_blocks)
+# with the kv dimension sequential ("arbitrary"), so VMEM holds only one
+# (block_q, d) Q tile, one (block_k, d) K/V tile and the running softmax
+# state in scratch — O(block) VMEM regardless of T. (The previous design
+# handed each kernel instance full-length K/V refs, which hit the 16MB
+# scoped-VMEM limit at T=8192.)
 
 def _causal_bias(q_start, k_start, block_q: int, block_k: int):
     """0 where col <= row, -inf above the diagonal (absolute positions)."""
@@ -41,143 +48,207 @@ def _causal_bias(q_start, k_start, block_q: int, block_k: int):
     return jnp.where(cols <= rows, 0.0, -jnp.inf).astype(jnp.float32)
 
 
-def _n_kv_blocks(q_start, block_q: int, block_k: int, kv_len: int,
-                 causal: bool):
-    """KV blocks a Q block must visit: all of them, or (causal) only those
-    intersecting the diagonal — shared by forward and dQ kernels."""
-    if not causal:
-        return kv_len // block_k
-    return (q_start + block_q + block_k - 1) // block_k
+def _vmem(shape, dtype):
+    """VMEM scratch when the TPU backend is importable; generic
+    memory-space scratch otherwise (interpret-mode envs without pltpu)."""
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    return pl.MemoryRef(jax.core.ShapedArray(shape, dtype), pl.ANY)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  kv_len: int, scale: float, causal: bool):
-    q = q_ref[0]  # (block_q, d)
-    q_start = pl.program_id(1) * block_q
-    m = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
-    l = jnp.zeros((q.shape[0],), jnp.float32)
-    acc = jnp.zeros(q.shape, jnp.float32)
-
-    def body(start, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :]
-        v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = s + _causal_bias(q_start, start * block_k, block_q, block_k)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = corr * l + jnp.sum(p, axis=-1)
-        acc_new = corr[:, None] * acc + jnp.dot(
-            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
-
-    # causal: blocks entirely above the diagonal contribute nothing — skip
-    n_blocks = _n_kv_blocks(q_start, block_q, block_k, kv_len, causal)
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
-    o_ref[0] = (acc / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
+def _kv_block_visible(q_start, k_start, block_q: int):
+    """Causal visibility of a KV block to a Q block: it contributes iff
+    its first column is <= the Q block's last row. Shared by all three
+    kernels so the skip bound cannot drift."""
+    return k_start <= q_start + block_q - 1
 
 
-def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: int,
-    kv_len: int, scale: float, causal: bool
+def _dim_semantics(interpret):
+    if interpret or pltpu is None:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
+def _flash_fwd_stream_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+    *, block_q: int, block_k: int, n_k: int, scale: float, causal: bool,
 ):
-    """Forward that also writes the per-row logsumexp (for the backward)."""
-    q = q_ref[0]
+    """One (q block, kv block) grid step of the online-softmax forward."""
+    kk = pl.program_id(2)
     q_start = pl.program_id(1) * block_q
-    m = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
-    l = jnp.zeros((q.shape[0],), jnp.float32)
-    acc = jnp.zeros(q.shape, jnp.float32)
+    k_start = kk * block_k
 
-    def body(start, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :]
-        v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    @pl.when(kk == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, -jnp.inf)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    def compute():
+        q = q_ref[0]
+        s = jnp.dot(
+            q, k_ref[0].T, preferred_element_type=jnp.float32
+        ) * scale
         if causal:
-            s = s + _causal_bias(q_start, start * block_k, block_q, block_k)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            s = s + _causal_bias(q_start, k_start, block_q, block_k)
+        m_prev = m_s[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = corr * l + jnp.sum(p, axis=-1)
-        acc_new = corr[:, None] * acc + jnp.dot(
-            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:, 0] = corr * l_s[:, 0] + jnp.sum(p, axis=-1)
+        acc_s[:] = corr[:, None] * acc_s[:] + jnp.dot(
+            p, v_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
+        m_s[:, 0] = m_new
 
-    n_blocks = _n_kv_blocks(q_start, block_q, block_k, kv_len, causal)
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    # lse carried as (bh, t, 1): a 2-D (bh, t) output would need a
-    # (1, block_q) block, which Mosaic rejects (second-to-last dim must
-    # be a multiple of 8 or the full array dim)
-    lse_ref[0, :, 0] = (m + jnp.log(l)).astype(jnp.float32)
+    if causal:
+        # blocks entirely above the diagonal contribute nothing
+        @pl.when(_kv_block_visible(q_start, k_start, block_q))
+        def _guarded():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kk == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+        # lse carried as (bh, t, 1): a 2-D (bh, t) output would need a
+        # (1, block_q) block, which Mosaic rejects (second-to-last dim
+        # must be a multiple of 8 or the full array dim)
+        lse_ref[0, :, 0] = (m_s[:, 0] + jnp.log(l)).astype(jnp.float32)
+
+
+def _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal):
+    bh, t, d = qf.shape
+    scale = 1.0 / (d**0.5)
+    n_k = t // block_k
+    kernel = functools.partial(
+        _flash_fwd_stream_kernel, block_q=block_q, block_k=block_k,
+        n_k=n_k, scale=scale, causal=causal,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ),
+        grid=(bh, t // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0)),
+        ),
+        scratch_shapes=[
+            _vmem((block_q, 1), jnp.float32),
+            _vmem((block_q, 1), jnp.float32),
+            _vmem((block_q, d), jnp.float32),
+        ],
+        compiler_params=_dim_semantics(interpret),
+        interpret=interpret,
+    )(qf, kf, vf)
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_q: int, block_k: int, kv_len: int, scale: float, causal: bool,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s,
+    *, block_q: int, block_k: int, n_k: int, scale: float, causal: bool,
 ):
-    """dQ for one Q block: stream K/V blocks, recompute p from the saved
-    logsumexp (no T x T materialization)."""
-    q = q_ref[0].astype(jnp.float32)
+    """dQ contribution of one KV block, accumulated in scratch."""
+    kk = pl.program_id(2)
     q_start = pl.program_id(1) * block_q
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
-    dq = jnp.zeros(q.shape, jnp.float32)
+    k_start = kk * block_k
 
-    def body(start, dq):
-        k_blk = k_ref[0, pl.dslice(start * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.dslice(start * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kk == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = s + _causal_bias(q_start, start * block_k, block_q, block_k)
+            s = s + _causal_bias(q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32) * scale
+        dq_s[:] = dq_s[:] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32
+        ) * scale
 
-    n_blocks = _n_kv_blocks(q_start, block_q, block_k, kv_len, causal)
-    dq = jax.lax.fori_loop(0, n_blocks, body, dq)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        @pl.when(_kv_block_visible(q_start, k_start, block_q))
+        def _guarded():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kk == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, block_k: int, q_len: int, scale: float, causal: bool,
+    dk_s, dv_s,
+    *, block_q: int, block_k: int, n_q: int, scale: float, causal: bool,
 ):
-    """dK/dV for one K/V block: stream Q blocks."""
-    k_blk = k_ref[0].astype(jnp.float32)
-    v_blk = v_ref[0].astype(jnp.float32)
-    k_start = pl.program_id(1) * block_k
-    dk = jnp.zeros(k_blk.shape, jnp.float32)
-    dv = jnp.zeros(v_blk.shape, jnp.float32)
+    """dK/dV contribution of one Q block, accumulated in scratch.
 
-    def body(start, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(start * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(start * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(start * block_q, block_q), 0]
-        delta = delta_ref[0, pl.dslice(start * block_q, block_q), 0]
+    Grid is (bh, kv_blocks, q_blocks): the K/V block is the parallel dim,
+    Q streams sequentially.
+    """
+    qq = pl.program_id(2)
+    k_start = pl.program_id(1) * block_k
+    q_start = qq * block_q
+
+    @pl.when(qq == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    def compute():
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = s + _causal_bias(start * block_q, k_start, block_q, block_k)
+            s = s + _causal_bias(q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_s[:] = dv_s[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
-        return dk, dv
+        dk_s[:] = dk_s[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        ) * scale
 
-    # causal: q blocks strictly above this K block's diagonal see none of it
-    start0 = k_start // block_q if causal else 0
-    dk, dv = jax.lax.fori_loop(start0, q_len // block_q, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # q blocks strictly above this K block see none of it
+        @pl.when(_kv_block_visible(q_start, k_start, block_q))
+        def _guarded():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qq == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
 def flash_attention(
@@ -195,29 +266,11 @@ def flash_attention(
     block_k = min(block_k, t)
     assert t % block_q == 0 and t % block_k == 0
     interpret = (not _on_tpu()) if interpret is None else interpret
-    scale = 1.0 / (d**0.5)
 
-    # fold batch and heads into the grid; Q tiled over rows
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    kernel = functools.partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, kv_len=t,
-        scale=scale, causal=causal,
-    )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        grid=(b * h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        interpret=interpret,
-    )(qf, kf, vf)
+    out, _ = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
@@ -225,40 +278,12 @@ def flash_attention(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
 def _flash_bhtd(qf, kf, vf, block_q, block_k, interpret, causal):
-    out, _ = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret, causal)
+    out, _ = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal)
     return out
 
 
-def _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret, causal):
-    bh, t, d = qf.shape
-    scale = 1.0 / (d**0.5)
-    kernel = functools.partial(
-        _flash_fwd_kernel, block_q=block_q, block_k=block_k, kv_len=t,
-        scale=scale, causal=causal,
-    )
-    out, lse = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
-        ),
-        grid=(bh, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
-        ),
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out, lse
-
-
 def _flash_fwd_rule(qf, kf, vf, block_q, block_k, interpret, causal):
-    out, lse = _flash_fwd_bhtd(qf, kf, vf, block_q, block_k, interpret, causal)
+    out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal)
     return out, (qf, kf, vf, out, lse)
 
 
@@ -266,6 +291,7 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
     qf, kf, vf, out, lse = res
     bh, t, d = qf.shape
     scale = 1.0 / (d**0.5)
+    n_q, n_k = t // block_q, t // block_k
     # delta_i = <dO_i, O_i> — the softmax normalizer correction; kept
     # (bh, t, 1) for the same Mosaic block-shape rule as lse
     delta = jnp.sum(
@@ -275,44 +301,51 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-            kv_len=t, scale=scale, causal=causal,
+            n_k=n_k, scale=scale, causal=causal,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
-        grid=(bh, t // block_q),
+        grid=(bh, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
+        compiler_params=_dim_semantics(interpret),
         interpret=interpret,
     )(qf, kf, vf, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-            q_len=t, scale=scale, causal=causal,
+            n_q=n_q, scale=scale, causal=causal,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
             jax.ShapeDtypeStruct((bh, t, d), vf.dtype),
         ),
-        grid=(bh, t // block_k),
+        grid=(bh, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, qq: (i, qq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, qq: (i, qq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, qq: (i, qq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, qq: (i, qq, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
         ),
+        scratch_shapes=[
+            _vmem((block_k, d), jnp.float32),
+            _vmem((block_k, d), jnp.float32),
+        ],
+        compiler_params=_dim_semantics(interpret),
         interpret=interpret,
     )(qf, kf, vf, do, lse, delta)
     return dq, dk, dv
