@@ -216,8 +216,8 @@ def main(argv=None) -> None:
         "--flash", action=argparse.BooleanOptionalAction, default=False,
         help="transformer workload: pallas flash attention instead of "
         "dense XLA attention. Dense is the default because it measured "
-        "faster at T=512 (947K vs 668K tokens/sec on v5e) — flash pays "
-        "in the long-T regime where the T x T matrix no longer fits",
+        "faster at T=512 (947K vs 474K tokens/sec on v5e) — flash wins "
+        "from T~2048 and is the only path that compiles at T=32768",
     )
     ap.add_argument(
         "--scaling", action="store_true",

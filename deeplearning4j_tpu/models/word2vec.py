@@ -53,9 +53,16 @@ def _hs_math(syn0, syn1, inputs, codes, points, mask, lr):
     """
     h = syn0[inputs]  # (B, D)
     w1 = syn1[points]  # (B, L, D)
-    dot = jnp.clip(jnp.einsum("bd,bld->bl", h, w1), -MAX_EXP, MAX_EXP)
+    dot = jnp.einsum("bd,bld->bl", h, w1)
     f = jax.nn.sigmoid(dot)
-    g = (1.0 - codes - f) * lr * mask  # (B, L)
+    # saturated dots are SKIPPED, not clipped, exactly as the reference's
+    # exp-table range check does (InMemoryLookupTable.iterateSample:
+    # continue when |dot| >= MAX_EXP). Clipping instead keeps updating
+    # saturated pairs with a constant-magnitude g, which feeds an
+    # oscillating syn0<->syn1 instability that blows weights up on small
+    # corpora trained for many epochs.
+    in_range = (jnp.abs(dot) < MAX_EXP).astype(f.dtype)
+    g = (1.0 - codes - f) * lr * mask * in_range  # (B, L)
     grad_in = jnp.einsum("bl,bld->bd", g, w1)
     syn1 = syn1.at[points].add(g[:, :, None] * h[:, None, :])
     syn0 = syn0.at[inputs].add(grad_in)
@@ -96,8 +103,15 @@ def _ns_step(syn0, syn1neg, inputs, targets, negatives, lr):
         [jnp.ones_like(targets[:, None]), jnp.zeros_like(negatives)], axis=1
     ).astype(syn0.dtype)
     w = syn1neg[rows]  # (B, 1+K, D)
-    dot = jnp.clip(jnp.einsum("bd,bkd->bk", h, w), -MAX_EXP, MAX_EXP)
-    g = (labels - jax.nn.sigmoid(dot)) * lr
+    dot = jnp.einsum("bd,bkd->bk", h, w)
+    # negative sampling SATURATES out-of-range dots to f=1/0 (full
+    # corrective update) — unlike HS, which skips them; this mirrors
+    # word2vec.c's `if (f > MAX_EXP) g = (label - 1) * alpha` branch
+    f = jnp.where(
+        dot > MAX_EXP, 1.0,
+        jnp.where(dot < -MAX_EXP, 0.0, jax.nn.sigmoid(dot)),
+    )
+    g = (labels - f) * lr
     grad_in = jnp.einsum("bk,bkd->bd", g, w)
     syn1neg = syn1neg.at[rows].add(g[:, :, None] * h[:, None, :])
     syn0 = syn0.at[inputs].add(grad_in)

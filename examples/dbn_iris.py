@@ -1,0 +1,52 @@
+"""DBN on Iris — the reference's de-facto acceptance test, end to end.
+
+≙ MultiLayerTest.testDbn (reference:
+deeplearning4j-core/src/test/java/org/deeplearning4j/nn/multilayer/
+MultiLayerTest.java:79-116): stacked Gaussian-visible RBMs pretrained
+with CD-1, conjugate-gradient finetune, evaluated with the confusion
+matrix / F1 machinery.
+
+Run: python examples/dbn_iris.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from deeplearning4j_tpu.datasets import ListDataSetIterator, fetchers
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn import conf as C
+
+
+def main():
+    ds = fetchers.iris().normalize_zero_mean_unit_variance()
+    train, test = ds.split_test_and_train(110)
+
+    base = C.LayerConfig(
+        layer_type="rbm",
+        activation="tanh",
+        visible_unit=C.VisibleUnit.GAUSSIAN,
+        hidden_unit=C.HiddenUnit.BINARY,
+        lr=0.05,
+        k=1,
+        num_iterations=100,
+        optimization_algo=C.OptimizationAlgorithm.CONJUGATE_GRADIENT,
+    )
+    mc = C.list_builder(
+        base, sizes=[6, 4], n_in=4, n_out=3, hidden_layer_type="rbm"
+    )
+    mc.backward = True
+
+    net = MultiLayerNetwork(mc)
+    net.init()
+    net.fit(ListDataSetIterator(train, 110))
+
+    ev = Evaluation(3)
+    ev.eval(test.labels, net.output(test.features))
+    print(ev.stats())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Word2Vec skip-gram on a toy corpus, similarity + nearest words.
+
+≙ Word2VecTests (reference: deeplearning4j-scaleout/deeplearning4j-nlp/
+src/test/java/org/deeplearning4j/models/word2vec/Word2VecTests.java):
+train on sentences, then query similarity("day", "night") and
+wordsNearest.
+
+Run: python examples/word2vec_similarity.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from deeplearning4j_tpu.models.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.sentence_iterator import CollectionSentenceIterator
+
+CORPUS = [
+    "the day was bright and the night was dark",
+    "day follows night and night follows day",
+    "a bright day a dark night",
+    "the sun rules the day the moon rules the night",
+    "night and day are opposites",
+    "every day has a night and every night has a day",
+] * 50
+
+
+def main():
+    w2v = Word2Vec(layer_size=32, window=3, min_word_frequency=1, seed=7,
+                   epochs=15)
+    sents = CollectionSentenceIterator(CORPUS)
+    w2v.build_vocab(sents)
+    sents.reset()
+    w2v.fit(sents)
+
+    print("similarity(day, night) =", w2v.similarity("day", "night"))
+    print("nearest to 'day':", w2v.words_nearest("day", top=5))
+
+
+if __name__ == "__main__":
+    main()
