@@ -377,7 +377,7 @@ def _report(args, per_chip: float, metric: str, jax) -> None:
     baseline = records.get(platform, {}).get(key) if comparable else None
     if baseline is None and comparable and args.dtype == "f32":
         records.setdefault(platform, {})[key] = per_chip
-        records[platform].setdefault("recorded", time.time())
+        records[platform][f"{key}_recorded"] = time.time()
         BASELINE_FILE.write_text(json.dumps(records))
         baseline = per_chip
     # null (not 1.0) when nothing was compared — a fake parity ratio would

@@ -9,6 +9,7 @@ WindowConverter.java:103).
 from __future__ import annotations
 
 import math
+import re
 from typing import Iterable
 
 import numpy as np
@@ -86,3 +87,54 @@ def window_to_vector(
         i = cache.index_of(w)
         vecs.append(np.asarray(embeddings[i]) if i >= 0 else np.zeros(dim, np.float32))
     return np.concatenate(vecs)
+
+
+_BEGIN_LABEL = re.compile(r"<([A-Za-z]+|\d+)>$")
+_END_LABEL = re.compile(r"</([A-Za-z]+|\d+)>$")
+
+
+def string_with_labels(
+    sentence: str,
+) -> tuple[str, dict[tuple[int, int], str]]:
+    """Strip inline ``<LABEL> ... </LABEL>`` markers from a sentence and
+    return (clean sentence, {(start, end): label} token spans) —
+    ≙ ContextLabelRetriever.stringWithLabels (reference:
+    text/movingwindow/ContextLabelRetriever.java:34-95), including its
+    error cases (unopened end label, unclosed begin label, mismatched
+    label pair).
+    """
+    # whitespace split, not a word tokenizer: the repo's word-regex
+    # tokenizers strip the <LABEL> markers before they can be matched
+    tokens = sentence.split()
+    spans: dict[tuple[int, int], str] = {}
+    clean: list[str] = []
+    curr_label: str | None = None
+    start = 0
+    for token in tokens:
+        begin = _BEGIN_LABEL.match(token)
+        end = _END_LABEL.match(token)
+        if begin:
+            if curr_label is not None:
+                raise ValueError(
+                    f"begin label <{begin.group(1)}> inside open label "
+                    f"<{curr_label}>"
+                )
+            curr_label = begin.group(1)
+            start = len(clean)
+        elif end:
+            if curr_label is None:
+                raise ValueError(
+                    f"end label </{end.group(1)}> with no begin label"
+                )
+            if end.group(1) != curr_label:
+                raise ValueError(
+                    f"label mismatch: <{curr_label}> closed by "
+                    f"</{end.group(1)}>"
+                )
+            spans[(start, len(clean))] = curr_label
+            curr_label = None
+        else:
+            clean.append(token)
+    if curr_label is not None:
+        raise ValueError(f"unclosed label <{curr_label}>")
+    return " ".join(clean), spans

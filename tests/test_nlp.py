@@ -294,3 +294,21 @@ def test_word2vec_many_epochs_stays_bounded():
     assert np.isfinite(syn0).all()
     assert np.abs(syn0).max() < 50.0, np.abs(syn0).max()
     assert np.isfinite(w2v.similarity("day", "night"))
+
+
+def test_context_label_retriever():
+    """≙ ContextLabelRetriever.stringWithLabels span extraction."""
+    from deeplearning4j_tpu.nlp.vectorizers import string_with_labels
+
+    clean, spans = string_with_labels(
+        "the <ORG> acme corp </ORG> hired <PER> jane </PER> today"
+    )
+    assert clean == "the acme corp hired jane today"
+    assert spans == {(1, 3): "ORG", (4, 5): "PER"}
+
+    with pytest.raises(ValueError, match="no begin label"):
+        string_with_labels("oops </ORG> here")
+    with pytest.raises(ValueError, match="unclosed"):
+        string_with_labels("<ORG> acme corp")
+    with pytest.raises(ValueError, match="mismatch"):
+        string_with_labels("<ORG> acme </PER>")
