@@ -1,7 +1,11 @@
 """Benchmark harness: model training throughput (samples/sec/chip).
 
-Workloads (BASELINE.json configs): LeNet-MNIST (default, the driver's
-headline metric) and AlexNet-CIFAR10 via ``--model alexnet``.
+Workloads: LeNet-MNIST (default, the driver's headline metric),
+AlexNet-CIFAR10 (``--model alexnet``), the Word2Vec hierarchical-softmax
+kernel in pairs/sec (``--model word2vec``), and the flagship transformer
+LM in tokens/sec (``--model transformer``, ``--flash`` to switch
+attention kernels). ``--scaling`` reports 1->N-chip data-parallel
+efficiency; ``--profile DIR`` captures an XPlane trace.
 
 Run on whatever accelerator the default environment exposes (one TPU chip
 under the driver).  Prints exactly one JSON line:

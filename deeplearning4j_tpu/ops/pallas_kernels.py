@@ -389,6 +389,10 @@ def _emb_dot_kernel(h_ref, w_ref, mask_ref, out_ref):
     w = w_ref[:]  # (block_b, L, d)
     mask = mask_ref[:]  # (block_b, L)
     dots = jnp.einsum("bd,bld->bl", h, w)
+    # clip for the sigmoid only — this is the READ side (f values); the
+    # skip-on-saturation semantics live in the gradient computation
+    # (_hs_math's in_range on g), not here: zeroing f would be
+    # indistinguishable from a genuinely small sigmoid downstream
     out_ref[:] = jax.nn.sigmoid(jnp.clip(dots, -6.0, 6.0)) * mask
 
 
