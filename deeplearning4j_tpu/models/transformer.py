@@ -302,18 +302,11 @@ def transformer_loss(cfg: TransformerConfig, mesh: Mesh | None = None):
     return loss
 
 
-def transformer_generate(cfg: TransformerConfig):
-    """Autoregressive sampling with a per-layer KV cache.
-
-    ≙ the reference's LSTM sampling/beam decode capability
-    (models/classifiers/lstm/LSTM.java:219,241) at the transformer level.
-    Returns ``generate(params, prompt, key, max_new, temperature, top_k)
-    -> tokens (B, Tp + max_new)``; the whole decode (prefill + sampling)
-    is two ``lax.scan``s inside one jittable function. ``temperature=0``
-    decodes greedily. MoE configs decode through the dense per-token
-    routing (generation is single-chip; capacity buffers are pointless
-    at T=1).
-    """
+def _decode_builder(cfg: TransformerConfig):
+    """Shared KV-cache decode machinery: returns
+    ``(forward_one, init_caches, prefill)`` used by sampling and beam
+    search. ``forward_one(params, caches, token, pos)`` advances one
+    position through all layers."""
 
     def block_decode(x, p, ck, cv, pos):
         # x: (B, D) one position; ck/cv: (B, L, H, K) this layer's cache
@@ -371,34 +364,62 @@ def transformer_generate(cfg: TransformerConfig):
         logits = x.astype(jnp.float32) @ params["head"]
         return logits, (ck_all, cv_all)
 
-    def generate(params, prompt, key, max_new: int,
-                 temperature: float = 1.0, top_k: int | None = None):
-        b, tp = prompt.shape
-        total = tp + max_new
-        if total > cfg.max_len:
-            raise ValueError(
-                f"prompt+max_new ({total}) exceeds max_len ({cfg.max_len})"
-            )
+    def init_caches(batch: int, total: int):
         nl, h, kd = cfg.n_layers, cfg.n_heads, cfg.head_dim
         # size caches (and thus every step's attention span) to the
         # actual decode length, not max_len
-        caches = (
-            jnp.zeros((nl, b, total, h, kd), cfg.compute_dtype),
-            jnp.zeros((nl, b, total, h, kd), cfg.compute_dtype),
+        return (
+            jnp.zeros((nl, batch, total, h, kd), cfg.compute_dtype),
+            jnp.zeros((nl, batch, total, h, kd), cfg.compute_dtype),
         )
 
-        # prefill: walk the prompt, building caches (logits discarded
-        # except the last position's, which seeds sampling)
-        def prefill(carry, pos):
+    def prefill(params, caches, prompt):
+        """Walk the prompt, building caches; returns (caches, last logits)."""
+        b, tp = prompt.shape
+
+        def one(carry, pos):
             caches, _ = carry
             logits, caches = forward_one(params, caches, prompt[:, pos], pos)
             return (caches, logits), None
 
         (caches, logits), _ = lax.scan(
-            prefill,
+            one,
             (caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
             jnp.arange(tp),
         )
+        return caches, logits
+
+    return forward_one, init_caches, prefill
+
+
+def _check_decode_len(cfg, tp, max_new):
+    total = tp + max_new
+    if total > cfg.max_len:
+        raise ValueError(
+            f"prompt+max_new ({total}) exceeds max_len ({cfg.max_len})"
+        )
+    return total
+
+
+def transformer_generate(cfg: TransformerConfig):
+    """Autoregressive sampling with a per-layer KV cache.
+
+    ≙ the reference's LSTM sampling decode capability
+    (models/classifiers/lstm/LSTM.java:219) at the transformer level.
+    Returns ``generate(params, prompt, key, max_new, temperature, top_k)
+    -> tokens (B, Tp + max_new)``; the whole decode (prefill + sampling)
+    is two ``lax.scan``s inside one jittable function. ``temperature=0``
+    decodes greedily. MoE configs decode through the dense per-token
+    routing (generation is single-chip; capacity buffers are pointless
+    at T=1).
+    """
+    forward_one, init_caches, do_prefill = _decode_builder(cfg)
+
+    def generate(params, prompt, key, max_new: int,
+                 temperature: float = 1.0, top_k: int | None = None):
+        b, tp = prompt.shape
+        total = _check_decode_len(cfg, tp, max_new)
+        caches, logits = do_prefill(params, init_caches(b, total), prompt)
 
         def sample(logits, key):
             if top_k is not None:
@@ -423,6 +444,80 @@ def transformer_generate(cfg: TransformerConfig):
         return jnp.concatenate([prompt, new_tokens.T], axis=1)
 
     return generate
+
+
+def transformer_beam_search(cfg: TransformerConfig):
+    """KV-cached beam-search decoding.
+
+    ≙ the reference's LSTM ``BeamSearch`` (models/classifiers/lstm/
+    LSTM.java:241-336) at the transformer level. Returns
+    ``beam(params, prompt, beam_width, max_new) ->
+    (tokens (B, W, Tp+max_new), log_probs (B, W))`` with beams sorted
+    best-first. The whole search is one ``lax.scan``: each step flattens
+    the (B, W) beams into the cache batch dim, expands the top W
+    continuations of each beam from the W*V candidate pool, and gathers
+    the caches of the surviving parents.
+    """
+    forward_one, init_caches, do_prefill = _decode_builder(cfg)
+
+    def beam(params, prompt, beam_width: int, max_new: int):
+        b, tp = prompt.shape
+        w = beam_width
+        v = cfg.vocab_size
+        total = _check_decode_len(cfg, tp, max_new)
+
+        # prefill once at batch B, then tile caches/logits to B*W beams
+        caches, logits = do_prefill(params, init_caches(b, total), prompt)
+        caches = jax.tree.map(
+            lambda c: jnp.repeat(c, w, axis=1), caches
+        )  # (nl, B*W, total, H, K)
+        logp = jax.nn.log_softmax(logits, axis=-1)  # (B, V)
+        # beam 0 holds the live hypothesis; the rest start at -inf so the
+        # first expansion draws W distinct tokens from beam 0's logits
+        scores = jnp.full((b, w), -jnp.inf).at[:, 0].set(0.0)
+        logp = jnp.repeat(logp[:, None], w, axis=1)  # (B, W, V)
+        tokens = jnp.zeros((b, w, max_new), prompt.dtype)
+
+        def step(carry, i):
+            caches, logp, scores, tokens = carry
+            cand = scores[:, :, None] + logp  # (B, W, V)
+            top_scores, flat_idx = lax.top_k(
+                cand.reshape(b, w * v), w
+            )  # (B, W)
+            parent = flat_idx // v  # (B, W) surviving beam index
+            tok = (flat_idx % v).astype(tokens.dtype)  # (B, W)
+            # reorder history + caches to the surviving parents
+            tokens = jnp.take_along_axis(
+                tokens, parent[:, :, None], axis=1
+            )
+            tokens = lax.dynamic_update_index_in_dim(
+                tokens, tok, i, axis=2
+            )
+            flat_parent = (
+                jnp.arange(b)[:, None] * w + parent
+            ).reshape(-1)  # (B*W,) into the cache batch dim
+            caches = jax.tree.map(
+                lambda c: jnp.take(c, flat_parent, axis=1), caches
+            )
+            logits, caches = forward_one(
+                params, caches, tok.reshape(-1), tp + i
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, w, v)
+            return (caches, logp, top_scores, tokens), None
+
+        (caches, logp, scores, tokens), _ = lax.scan(
+            step, (caches, logp, scores, tokens), jnp.arange(max_new)
+        )
+        # sort beams best-first
+        order = jnp.argsort(-scores, axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
+        full = jnp.concatenate(
+            [jnp.repeat(prompt[:, None], w, axis=1), tokens], axis=2
+        )
+        return full, scores
+
+    return beam
 
 
 def fsdp_shardings(mesh: Mesh, cfg: TransformerConfig):

@@ -250,6 +250,53 @@ def test_flash_attention_transformer_matches_dense():
     assert all(np.isfinite(np.asarray(a)).all() for a in jax.tree.leaves(g))
 
 
+def test_beam_search_width1_equals_greedy():
+    from deeplearning4j_tpu.models.transformer import (
+        transformer_beam_search,
+        transformer_generate,
+    )
+
+    params = init_transformer(jax.random.key(50), CFG)
+    prompt = _tokens(2, 5, seed=50)
+    greedy = transformer_generate(CFG)(
+        params, prompt, jax.random.key(0), 6, temperature=0
+    )
+    beams, scores = transformer_beam_search(CFG)(params, prompt, 1, 6)
+    np.testing.assert_array_equal(np.asarray(beams[:, 0]), np.asarray(greedy))
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_beam_search_finds_higher_likelihood_than_greedy():
+    from deeplearning4j_tpu.models.transformer import (
+        transformer_beam_search,
+        transformer_generate,
+    )
+
+    params = init_transformer(jax.random.key(51), CFG)
+    prompt = _tokens(2, 4, seed=51)
+    apply = transformer_apply(CFG)
+
+    def seq_logprob(seq, tp):
+        logits, _ = apply(params, seq[:, :-1])
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        tgt = seq[:, 1:]
+        tok_lp = jnp.take_along_axis(lp, tgt[:, :, None], axis=2)[..., 0]
+        return jnp.sum(tok_lp[:, tp - 1 :], axis=1)  # new tokens only
+
+    greedy = transformer_generate(CFG)(
+        params, prompt, jax.random.key(0), 6, temperature=0
+    )
+    beams, scores = transformer_beam_search(CFG)(params, prompt, 4, 6)
+    # scores sorted best-first and consistent with the true sequence
+    # log-likelihood of the best beam
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-5).all()
+    best_lp = np.asarray(seq_logprob(beams[:, 0], 4))
+    np.testing.assert_allclose(s[:, 0], best_lp, atol=1e-4)
+    greedy_lp = np.asarray(seq_logprob(greedy, 4))
+    assert (best_lp >= greedy_lp - 1e-5).all()
+
+
 def test_bf16_compute_runs_and_is_close():
     cfg_bf16 = TransformerConfig(**{
         **CFG.__dict__, "compute_dtype": jnp.bfloat16
