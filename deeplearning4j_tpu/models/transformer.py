@@ -58,6 +58,9 @@ class TransformerConfig:
     # pallas flash-attention kernels (causal, custom-vjp backward, O(T)
     # memory) in place of dense attention; needs T <= 128 or T % 128 == 0
     use_flash: bool = False
+    # rotary position embeddings on q/k (RoPE) instead of relying solely
+    # on the learned absolute table — the modern long-context scheme
+    rope: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -174,6 +177,23 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return out.astype(x.dtype)
 
 
+def _rope_tables(positions, head_dim: int, dtype, base: float = 10000.0):
+    """(cos, sin) tables for RoPE at the given positions: (..., head_dim/2)."""
+    half = head_dim // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[..., None].astype(jnp.float32) * freqs  # (..., half)
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def _apply_rope(x, cos, sin):
+    """Rotate pairs of head-dim channels. x: (..., head_dim); cos/sin
+    broadcastable to (..., head_dim/2)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+
+
 def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
     """Build apply(params, tokens) -> (logits (B, T, V), aux_loss), causal.
 
@@ -188,6 +208,11 @@ def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
             "use_flash and sequence_parallel are mutually exclusive: the "
             "sequence-parallel path attends via the ring, not the local "
             "flash kernel"
+        )
+    if cfg.rope and cfg.head_dim % 2:
+        raise ValueError(
+            f"rope needs an even head_dim, got {cfg.head_dim} "
+            f"(d_model {cfg.d_model} / n_heads {cfg.n_heads})"
         )
     if cfg.n_experts:
         if cfg.n_experts != mesh.shape[mesh_lib.MODEL_AXIS]:
@@ -218,22 +243,32 @@ def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
         qkv = jnp.einsum(
             "btd,dshk->sbthk", h_in, p["wqkv"].astype(x.dtype)
         )
+        q_h, k_h, v_h = qkv[0], qkv[1], qkv[2]
+        if cfg.rope:
+            t = q_h.shape[1]
+            cos, sin = _rope_tables(
+                jnp.arange(t), cfg.head_dim, q_h.dtype
+            )  # (T, hd/2)
+            cos = cos[None, :, None, :]
+            sin = sin[None, :, None, :]
+            q_h = _apply_rope(q_h, cos, sin)
+            k_h = _apply_rope(k_h, cos, sin)
         if cfg.sequence_parallel:
-            o = ring(qkv[0], qkv[1], qkv[2])
+            o = ring(q_h, k_h, v_h)
         elif cfg.use_flash:
             from deeplearning4j_tpu.ops.pallas_kernels import (
                 flash_attention_trainable,
             )
 
-            t = qkv.shape[2]
+            t = q_h.shape[1]
             if t > 128 and t % 128:
                 raise ValueError(
                     f"use_flash needs seq len <= 128 or a multiple of "
                     f"128, got {t}"
                 )
-            o = flash_attention_trainable(qkv[0], qkv[1], qkv[2], causal=True)
+            o = flash_attention_trainable(q_h, k_h, v_h, causal=True)
         else:
-            o = attention(qkv[0], qkv[1], qkv[2], causal=True)
+            o = attention(q_h, k_h, v_h, causal=True)
         x = x + jnp.einsum("bthk,hkd->btd", o, p["wo"].astype(x.dtype))
         # ffn sublayer: dense MLP or routed MoE
         h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
@@ -313,6 +348,10 @@ def _decode_builder(cfg: TransformerConfig):
         h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
         qkv = jnp.einsum("bd,dshk->sbhk", h_in, p["wqkv"].astype(x.dtype))
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if cfg.rope:
+            cos, sin = _rope_tables(pos, cfg.head_dim, x.dtype)  # (hd/2,)
+            q = _apply_rope(q, cos[None, None], sin[None, None])
+            k = _apply_rope(k, cos[None, None], sin[None, None])
         ck = lax.dynamic_update_slice(ck, k[:, None], (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v[:, None], (0, pos, 0, 0))
         d = q.shape[-1]

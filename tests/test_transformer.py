@@ -307,3 +307,64 @@ def test_bf16_compute_runs_and_is_close():
     y16, _ = transformer_apply(cfg_bf16)(params, toks)
     assert y16.dtype == jnp.float32  # logits promoted for stable softmax
     assert float(jnp.mean(jnp.abs(y32 - y16))) < 0.1
+
+
+def test_rope_causality_and_decode_parity():
+    from deeplearning4j_tpu.models.transformer import transformer_generate
+
+    cfg = _cfg(rope=True)
+    params = init_transformer(jax.random.key(60), cfg)
+    apply = transformer_apply(cfg)
+    toks = _tokens(2, 16, seed=60)
+    logits, _ = apply(params, toks)
+    # causality still holds with rotated q/k
+    toks2 = toks.at[:, 10].set((toks[:, 10] + 1) % CFG.vocab_size)
+    logits2, _ = apply(params, toks2)
+    np.testing.assert_allclose(
+        np.asarray(logits[:, :10]), np.asarray(logits2[:, :10]), atol=1e-5
+    )
+    # KV-cache decode applies the same rotation as the full forward
+    prompt = toks[:, :5]
+    out = transformer_generate(cfg)(
+        params, prompt, jax.random.key(0), 6, temperature=0
+    )
+    seq = prompt
+    for _ in range(6):
+        lg, _ = apply(params, seq)
+        nxt = jnp.argmax(lg[:, -1], axis=-1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_rope_dot_products_depend_only_on_relative_offset():
+    # the core RoPE property: <rope(q, m), rope(k, n)> is a function of
+    # (m - n) only — shifting both positions by the same amount leaves
+    # every attention logit unchanged
+    from deeplearning4j_tpu.models.transformer import (
+        _apply_rope,
+        _rope_tables,
+    )
+
+    rng = np.random.default_rng(61)
+    hd = 16
+    q = jnp.asarray(rng.normal(size=(hd,)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(hd,)).astype(np.float32))
+
+    def dot_at(m, n):
+        cq, sq = _rope_tables(jnp.asarray(m), hd, jnp.float32)
+        ck, sk = _rope_tables(jnp.asarray(n), hd, jnp.float32)
+        return float(_apply_rope(q, cq, sq) @ _apply_rope(k, ck, sk))
+
+    for m, n in ((3, 1), (7, 0), (5, 5)):
+        for shift in (1, 11, 100):
+            np.testing.assert_allclose(
+                dot_at(m, n), dot_at(m + shift, n + shift), rtol=1e-5
+            )
+    # and it genuinely varies with the offset (not constant)
+    assert abs(dot_at(3, 1) - dot_at(6, 1)) > 1e-4
+
+
+def test_rope_rejects_odd_head_dim():
+    cfg = TransformerConfig(d_model=96, n_heads=32, rope=True)
+    with pytest.raises(ValueError, match="even head_dim"):
+        transformer_apply(cfg)
