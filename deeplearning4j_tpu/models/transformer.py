@@ -61,11 +61,25 @@ class TransformerConfig:
     # rotary position embeddings on q/k (RoPE) instead of relying solely
     # on the learned absolute table — the modern long-context scheme
     rope: bool = False
+    # grouped-query attention: number of KV heads (None = n_heads, plain
+    # MHA). Shrinks the decode KV cache n_heads/n_kv_heads-fold
+    n_kv_heads: int | None = None
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    def __post_init__(self):
+        if self.n_heads % self.kv_heads:
+            raise ValueError(
+                f"n_kv_heads ({self.kv_heads}) must divide n_heads "
+                f"({self.n_heads})"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 def init_transformer(key, cfg: TransformerConfig):
@@ -98,13 +112,21 @@ def init_transformer(key, cfg: TransformerConfig):
             "w2": norm(ks[5], (nl, f, d), s_f),
             "b2": jnp.zeros((nl, d)),
         }
+    if cfg.kv_heads == h:
+        attn = {"wqkv": norm(ks[2], (nl, d, 3, h, k), s_d)}
+    else:  # GQA: separate projections, fewer KV heads
+        kq, kk = jax.random.split(ks[2])
+        attn = {
+            "wq": norm(kq, (nl, d, h, k), s_d),
+            "wkv": norm(kk, (nl, d, 2, cfg.kv_heads, k), s_d),
+        }
     return {
         "embed": norm(ks[0], (cfg.vocab_size, d), 0.02),
         "pos": norm(ks[1], (cfg.max_len, d), 0.02),
         "blocks": {
             "ln1_scale": jnp.ones((nl, d)),
             "ln1_bias": jnp.zeros((nl, d)),
-            "wqkv": norm(ks[2], (nl, d, 3, h, k), s_d),
+            **attn,
             "wo": norm(ks[3], (nl, h, k, d), s_d),
             "ln2_scale": jnp.ones((nl, d)),
             "ln2_bias": jnp.zeros((nl, d)),
@@ -143,6 +165,17 @@ def transformer_shardings(mesh: Mesh, cfg: TransformerConfig | None = None):
             "w2": ns(None, m, None),  # row-parallel
             "b2": rep,
         }
+    if cfg is not None and cfg.kv_heads != cfg.n_heads:
+        # GQA: q column-parallel on heads; KV sharded on its head dim
+        # when it divides the model axis, else replicated (the standard
+        # MQA-on-TP layout — every rank holds the single KV head)
+        kv_fits = cfg.kv_heads % mesh.shape[m] == 0
+        attn = {
+            "wq": ns(None, None, m, None),
+            "wkv": ns(None, None, None, m, None) if kv_fits else rep,
+        }
+    else:
+        attn = {"wqkv": ns(None, None, None, m, None)}
     return {
         "embed": rep,
         "pos": rep,
@@ -150,7 +183,7 @@ def transformer_shardings(mesh: Mesh, cfg: TransformerConfig | None = None):
             "ln1_scale": rep,
             "ln1_bias": rep,
             # column-parallel on heads: each model shard owns H/tp heads
-            "wqkv": ns(None, None, None, m, None),
+            **attn,
             # row-parallel back to d_model (psum inserted by XLA)
             "wo": ns(None, m, None, None),
             "ln2_scale": rep,
@@ -240,10 +273,19 @@ def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
     def block(x, p):
         # attention sublayer
         h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
-        qkv = jnp.einsum(
-            "btd,dshk->sbthk", h_in, p["wqkv"].astype(x.dtype)
-        )
-        q_h, k_h, v_h = qkv[0], qkv[1], qkv[2]
+        if cfg.kv_heads != cfg.n_heads:
+            q_h = jnp.einsum("btd,dhk->bthk", h_in, p["wq"].astype(x.dtype))
+            kv = jnp.einsum(
+                "btd,dshk->sbthk", h_in, p["wkv"].astype(x.dtype)
+            )
+            g = cfg.n_heads // cfg.kv_heads
+            k_h = jnp.repeat(kv[0], g, axis=2)
+            v_h = jnp.repeat(kv[1], g, axis=2)
+        else:
+            qkv = jnp.einsum(
+                "btd,dshk->sbthk", h_in, p["wqkv"].astype(x.dtype)
+            )
+            q_h, k_h, v_h = qkv[0], qkv[1], qkv[2]
         if cfg.rope:
             t = q_h.shape[1]
             cos, sin = _rope_tables(
@@ -344,10 +386,18 @@ def _decode_builder(cfg: TransformerConfig):
     position through all layers."""
 
     def block_decode(x, p, ck, cv, pos):
-        # x: (B, D) one position; ck/cv: (B, L, H, K) this layer's cache
+        # x: (B, D) one position; ck/cv: (B, L, H_kv, K) this layer's
+        # cache — under GQA it holds only kv_heads, the memory win
         h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
-        qkv = jnp.einsum("bd,dshk->sbhk", h_in, p["wqkv"].astype(x.dtype))
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        if cfg.kv_heads != cfg.n_heads:
+            q = jnp.einsum("bd,dhk->bhk", h_in, p["wq"].astype(x.dtype))
+            kv = jnp.einsum("bd,dshk->sbhk", h_in, p["wkv"].astype(x.dtype))
+            k, v = kv[0], kv[1]
+        else:
+            qkv = jnp.einsum(
+                "bd,dshk->sbhk", h_in, p["wqkv"].astype(x.dtype)
+            )
+            q, k, v = qkv[0], qkv[1], qkv[2]
         if cfg.rope:
             cos, sin = _rope_tables(pos, cfg.head_dim, x.dtype)  # (hd/2,)
             q = _apply_rope(q, cos[None, None], sin[None, None])
@@ -355,13 +405,16 @@ def _decode_builder(cfg: TransformerConfig):
         ck = lax.dynamic_update_slice(ck, k[:, None], (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v[:, None], (0, pos, 0, 0))
         d = q.shape[-1]
-        logits = jnp.einsum("bhk,bthk->bht", q, ck) / jnp.sqrt(d).astype(
+        grp = cfg.n_heads // cfg.kv_heads
+        qg = q.reshape(q.shape[0], cfg.kv_heads, grp, d)
+        logits = jnp.einsum("bhgk,bthk->bhgt", qg, ck) / jnp.sqrt(d).astype(
             x.dtype
         )
-        mask = (jnp.arange(ck.shape[1]) <= pos)[None, None, :]
+        mask = (jnp.arange(ck.shape[1]) <= pos)[None, None, None, :]
         logits = jnp.where(mask, logits, -jnp.inf)
         w = jax.nn.softmax(logits, axis=-1)
-        o = jnp.einsum("bht,bthk->bhk", w, cv)
+        o = jnp.einsum("bhgt,bthk->bhgk", w, cv)
+        o = o.reshape(o.shape[0], cfg.n_heads, d)
         x = x + jnp.einsum("bhk,hkd->bd", o, p["wo"].astype(x.dtype))
         h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
         if cfg.n_experts:
@@ -404,7 +457,7 @@ def _decode_builder(cfg: TransformerConfig):
         return logits, (ck_all, cv_all)
 
     def init_caches(batch: int, total: int):
-        nl, h, kd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        nl, h, kd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
         # size caches (and thus every step's attention span) to the
         # actual decode length, not max_len
         return (

@@ -368,3 +368,74 @@ def test_rope_rejects_odd_head_dim():
     cfg = TransformerConfig(d_model=96, n_heads=32, rope=True)
     with pytest.raises(ValueError, match="even head_dim"):
         transformer_apply(cfg)
+
+
+def test_gqa_forward_decode_and_tp_parity(devices):
+    from deeplearning4j_tpu.models.transformer import transformer_generate
+
+    cfg = _cfg(n_kv_heads=2, rope=True)  # 4 q heads, 2 kv heads
+    params = init_transformer(jax.random.key(70), cfg)
+    apply = transformer_apply(cfg)
+    toks = _tokens(2, 16, seed=70)
+    logits, _ = apply(params, toks)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # causality
+    toks2 = toks.at[:, 10].set((toks[:, 10] + 1) % cfg.vocab_size)
+    logits2, _ = apply(params, toks2)
+    np.testing.assert_allclose(
+        np.asarray(logits[:, :10]), np.asarray(logits2[:, :10]), atol=1e-5
+    )
+    # KV-cache decode (cache holds only 2 kv heads) == full forward
+    prompt = toks[:, :5]
+    out = transformer_generate(cfg)(
+        params, prompt, jax.random.key(0), 6, temperature=0
+    )
+    seq = prompt
+    for _ in range(6):
+        lg, _ = apply(params, seq)
+        nxt = jnp.argmax(lg[:, -1], axis=-1).astype(seq.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+    # TP over 2 model shards (2 kv heads -> 1 per shard) matches replicated
+    mesh = mesh_lib.dp_mp_mesh(4, 2)
+    y_tp, _ = jax.jit(transformer_apply(cfg))(
+        place_transformer_params(mesh, params, cfg), toks
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(y_tp), atol=2e-4
+    )
+
+
+def test_gqa_training_learns(devices):
+    mesh = mesh_lib.dp_mp_mesh(4, 2)
+    cfg = _cfg(n_kv_heads=2)
+    step, init_state, shard_tokens = transformer_train_step(mesh, cfg)
+    params, opt_state = init_state(jax.random.key(71))
+    toks = shard_tokens(_tokens(8, 17, seed=71))
+    losses = []
+    for _ in range(30):
+        params, opt_state, l = step(params, opt_state, toks)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses[::10]
+
+
+def test_gqa_rejects_indivisible_heads():
+    # validated at config construction, shared by every entry point
+    with pytest.raises(ValueError, match="must divide"):
+        _cfg(n_kv_heads=3)
+
+
+def test_mqa_tp_replicated_kv(devices):
+    # MQA (1 kv head) on 2-way TP: wkv replicated, outputs still match
+    cfg = _cfg(n_kv_heads=1)
+    params = init_transformer(jax.random.key(72), cfg)
+    toks = _tokens(2, 16, seed=72)
+    y_rep, _ = transformer_apply(cfg)(params, toks)
+    mesh = mesh_lib.dp_mp_mesh(4, 2)
+    y_tp, _ = jax.jit(transformer_apply(cfg))(
+        place_transformer_params(mesh, params, cfg), toks
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_rep), np.asarray(y_tp), atol=2e-4
+    )
