@@ -39,6 +39,7 @@ def _train_transformer(args) -> int:
 
     from deeplearning4j_tpu.models.transformer import (
         TransformerConfig,
+        lm_optimizer,
         transformer_generate,
         transformer_train_step,
     )
@@ -95,7 +96,9 @@ def _train_transformer(args) -> int:
         n_experts=args.n_experts,
     )
     step, init_state, shard_tokens = transformer_train_step(
-        mesh, cfg, fsdp=args.fsdp
+        mesh, cfg,
+        optimizer=lm_optimizer(total_steps=args.steps),
+        fsdp=args.fsdp,
     )
     params, opt_state = init_state(jax.random.key(0))
 

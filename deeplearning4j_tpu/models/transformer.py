@@ -641,6 +641,35 @@ def fsdp_shardings(mesh: Mesh, cfg: TransformerConfig):
     return jax.tree.map(augment, base, shapes)
 
 
+def lm_optimizer(
+    peak_lr: float = 3e-4,
+    total_steps: int = 10_000,
+    warmup_steps: int | None = None,
+    clip_norm: float = 1.0,
+    weight_decay: float = 0.01,
+) -> optax.GradientTransformation:
+    """Standard LM training recipe: global-norm clipping + AdamW on a
+    linear-warmup / cosine-decay schedule. Pass to
+    ``transformer_train_step(optimizer=...)``; the state mirrors the
+    param tree, so TP/FSDP shardings carry over unchanged."""
+    warmup = warmup_steps if warmup_steps is not None else max(
+        1, total_steps // 20
+    )
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=warmup,
+        # optax needs decay_steps > warmup_steps; tiny smoke runs
+        # (total_steps <= warmup) must still construct
+        decay_steps=max(total_steps, warmup + 1),
+        end_value=peak_lr * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(sched, weight_decay=weight_decay),
+    )
+
+
 def transformer_train_step(
     mesh: Mesh, cfg: TransformerConfig, optimizer=None, fsdp: bool = False
 ):

@@ -439,3 +439,20 @@ def test_mqa_tp_replicated_kv(devices):
     np.testing.assert_allclose(
         np.asarray(y_rep), np.asarray(y_tp), atol=2e-4
     )
+
+
+def test_lm_optimizer_trains_with_warmup_and_clipping(devices):
+    from deeplearning4j_tpu.models.transformer import lm_optimizer
+
+    mesh = mesh_lib.dp_mp_mesh(2, 4)
+    step, init_state, shard_tokens = transformer_train_step(
+        mesh, CFG, optimizer=lm_optimizer(peak_lr=1e-3, total_steps=40)
+    )
+    params, opt_state = init_state(jax.random.key(80))
+    toks = shard_tokens(_tokens(8, 17, seed=80))
+    losses = []
+    for _ in range(40):
+        params, opt_state, l = step(params, opt_state, toks)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
