@@ -104,6 +104,17 @@ def _train_transformer(args) -> int:
 
     mgr = None
     if args.checkpoint_dir:
+        if args.checkpoint_backend == "npz" and jax.process_count() > 1:
+            # the npz backend gathers every leaf to host via np.asarray;
+            # in a multi-process run TP/FSDP-sharded leaves are not fully
+            # addressable and the first save would raise deep inside jax.
+            # Fail fast with the fix instead.
+            print(
+                "npz checkpoints cannot address multi-process shardings; "
+                "use --checkpoint-backend orbax for distributed runs",
+                file=sys.stderr,
+            )
+            return 2
         if args.checkpoint_backend == "orbax":
             from deeplearning4j_tpu.parallel.checkpoint import (
                 AsyncShardedCheckpointManager,

@@ -641,6 +641,29 @@ def fsdp_shardings(mesh: Mesh, cfg: TransformerConfig):
     return jax.tree.map(augment, base, shapes)
 
 
+# param leaves exempt from AdamW weight decay: layernorm scales/biases,
+# biases, and the learned position table — the standard LM recipe decays
+# only the matmul weights. Matched by leaf *name* because the stacked
+# (n_layers, ...) leading axis makes block biases 2-D, so an ndim test
+# would misclassify them.
+_NO_DECAY = frozenset({
+    "ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias",
+    "lnf_scale", "lnf_bias", "b1", "b2", "pos",
+})
+
+
+def _decay_mask(params):
+    """True where AdamW weight decay applies (matmul weights only)."""
+
+    def leaf_name(path):
+        last = path[-1]
+        return getattr(last, "key", None) or getattr(last, "name", "")
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: leaf_name(path) not in _NO_DECAY, params
+    )
+
+
 def lm_optimizer(
     peak_lr: float = 3e-4,
     total_steps: int = 10_000,
@@ -651,7 +674,9 @@ def lm_optimizer(
     """Standard LM training recipe: global-norm clipping + AdamW on a
     linear-warmup / cosine-decay schedule. Pass to
     ``transformer_train_step(optimizer=...)``; the state mirrors the
-    param tree, so TP/FSDP shardings carry over unchanged."""
+    param tree, so TP/FSDP shardings carry over unchanged. Weight decay
+    is masked off norm scales/biases, biases, and the position table
+    (``_decay_mask``), matching the standard LM recipe."""
     warmup = warmup_steps if warmup_steps is not None else max(
         1, total_steps // 20
     )
@@ -666,7 +691,7 @@ def lm_optimizer(
     )
     return optax.chain(
         optax.clip_by_global_norm(clip_norm),
-        optax.adamw(sched, weight_decay=weight_decay),
+        optax.adamw(sched, weight_decay=weight_decay, mask=_decay_mask),
     )
 
 

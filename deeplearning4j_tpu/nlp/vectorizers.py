@@ -102,6 +102,11 @@ def string_with_labels(
     text/movingwindow/ContextLabelRetriever.java:34-95), including its
     error cases (unopened end label, unclosed begin label, mismatched
     label pair).
+
+    Deviation from the parity surface (noted in PARITY.md): spans are
+    *token-index* ranges into the whitespace-split clean sentence, not
+    the reference's character offsets — token indices are what the
+    moving-window vectorizer downstream consumes.
     """
     # whitespace split, not a word tokenizer: the repo's word-regex
     # tokenizers strip the <LABEL> markers before they can be matched

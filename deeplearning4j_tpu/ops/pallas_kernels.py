@@ -50,10 +50,12 @@ def _causal_bias(q_start, k_start, block_q: int, block_k: int):
 
 def _vmem(shape, dtype):
     """VMEM scratch when the TPU backend is importable; generic
-    memory-space scratch otherwise (interpret-mode envs without pltpu)."""
+    memory-space scratch otherwise (interpret-mode envs without pltpu).
+    ``pl.ANY(shape, dtype)`` is the public scratch-shape API (memory-space
+    enums are callable MemoryRef factories in jax>=0.9)."""
     if pltpu is not None:
         return pltpu.VMEM(shape, dtype)
-    return pl.MemoryRef(jax.core.ShapedArray(shape, dtype), pl.ANY)
+    return pl.ANY(shape, dtype)
 
 
 def _kv_block_visible(q_start, k_start, block_q: int):
