@@ -131,6 +131,11 @@ def _train_transformer(args) -> int:
             )
 
     svc = ClusterService()
+    svc.model_description = (
+        f"transformer d_model={cfg.d_model} n_layers={cfg.n_layers} "
+        f"n_heads={cfg.n_heads} d_ff={cfg.d_ff} vocab={cfg.vocab_size} "
+        f"seq_len={args.seq_len} experts={cfg.n_experts} fsdp={args.fsdp}"
+    )
     if args.status_port is not None:
         port = svc.start_rest_api(args.status_port)
         print(f"status REST on http://127.0.0.1:{port}/statetracker")
@@ -138,8 +143,17 @@ def _train_transformer(args) -> int:
 
     rng = np.random.default_rng(0)
     batch = max(dp, args.batch - args.batch % dp)
+    svc.minibatch = batch
     loss = l = None
     for i in range(args.steps):
+        # live batch-size control: POST /statetracker/minibatch changes
+        # the sampled batch (rounded to the dp axis; a new shape means
+        # one re-jit on the next step) — ≙ the reference's POST
+        # minibatch resource
+        posted = max(dp, svc.minibatch - svc.minibatch % dp)
+        if posted != batch:
+            batch = posted
+            print(f"minibatch -> {batch} (REST)")
         starts = rng.integers(0, len(arr) - args.seq_len - 1, batch)
         toks = np.stack([arr[s : s + args.seq_len + 1] for s in starts])
         params, opt_state, l = step(
@@ -155,6 +169,8 @@ def _train_transformer(args) -> int:
             loss = float(l)
             if (i + 1) % 20 == 0:
                 print(f"step {i + 1}/{args.steps} loss {loss:.4f}")
+            # report_loss returns True for patience exhaustion AND for a
+            # POSTed /statetracker/earlystop
             if svc.report_loss(loss):
                 print("early stop triggered")
                 break

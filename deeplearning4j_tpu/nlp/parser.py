@@ -1,0 +1,240 @@
+"""PCFG-CKY constituency parser.
+
+≙ the reference's TreeParser (text/corpora/treeparser/TreeParser.java),
+which turns raw text into constituency trees through UIMA/OpenNLP
+models, feeding RNTN and the recursive autoencoder. No pretrained
+OpenNLP models exist offline, so this module replaces round 1's
+right-branching fallback with a real parser: a probabilistic CFG
+extracted from a bundled PTB-style mini-treebank, decoded with CKY
+(exact Viterbi parse over the binarized grammar).
+
+Pipeline parity:
+- grammar extraction runs the same binarize + collapse-unaries
+  transforms the reference applies to parser output
+  (BinarizeTreeTransformer.java:133, CollapseUnaries), so CKY's
+  derivations live in exactly the tree space downstream models consume;
+- unknown words back off to an open-class tag distribution estimated
+  from singleton counts (standard PCFG practice), so novel sentences
+  still parse;
+- sentences the grammar cannot span fall back to the round-1
+  right-branching tree — the consumer contract (every sentence yields a
+  binary tree) is unchanged.
+
+The bundled treebank is a hand-built, self-consistent sample in
+Penn-treebank bracketed style: enough NP/VP/PP/SBAR structure that
+parsed trees are measurably non-right-branching (subject NPs with PP
+attachment produce left-heavy splits no right-branching fallback can).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from collections import Counter, defaultdict
+
+from deeplearning4j_tpu.nlp.tree import (
+    Tree,
+    binarize,
+    collapse_unaries,
+    parse_ptb,
+    right_branching_tree,
+)
+
+# -- bundled mini-treebank ----------------------------------------------------
+# Hand-written PTB-style sample trees (the role the reference's OpenNLP
+# model files play). Kept deliberately small and regular: DT/JJ/NN NPs,
+# PP attachment to both NP and VP, transitive and ditransitive VPs,
+# pronouns and proper nouns.
+_TREEBANK = """
+(S (NP (DT the) (NN cat)) (VP (VBD saw) (NP (DT a) (NN dog))))
+(S (NP (DT the) (NN dog)) (VP (VBD chased) (NP (DT the) (NN cat))))
+(S (NP (DT a) (NN man)) (VP (VBD read) (NP (DT a) (NN book))))
+(S (NP (DT the) (NN woman)) (VP (VBD liked) (NP (DT the) (NN park))))
+(S (NP (DT the) (NN child)) (VP (VBD found) (NP (DT a) (NN ball))))
+(S (NP (DT a) (NN bird)) (VP (VBD watched) (NP (DT the) (NN fish))))
+(S (NP (NP (DT the) (NN cat)) (PP (IN on) (NP (DT the) (NN mat)))) (VP (VBD saw) (NP (DT a) (NN dog))))
+(S (NP (NP (DT the) (NN man)) (PP (IN in) (NP (DT the) (NN park)))) (VP (VBD read) (NP (DT a) (NN book))))
+(S (NP (NP (DT a) (NN dog)) (PP (IN near) (NP (DT the) (NN tree)))) (VP (VBD chased) (NP (DT the) (NN bird))))
+(S (NP (NP (DT the) (NN woman)) (PP (IN with) (NP (DT the) (NN ball)))) (VP (VBD watched) (NP (DT the) (NN child))))
+(S (NP (DT the) (NN cat)) (VP (VBD sat) (PP (IN on) (NP (DT the) (NN mat)))))
+(S (NP (DT the) (NN dog)) (VP (VBD slept) (PP (IN under) (NP (DT the) (NN tree)))))
+(S (NP (DT the) (NN man)) (VP (VBD walked) (PP (IN in) (NP (DT the) (NN park)))))
+(S (NP (DT the) (NN child)) (VP (VBD played) (PP (IN with) (NP (DT a) (NN ball)))))
+(S (NP (DT the) (NN woman)) (VP (VBD gave) (NP (DT the) (NN dog)) (NP (DT a) (NN fish))))
+(S (NP (DT the) (NN man)) (VP (VBD gave) (NP (DT the) (NN child)) (NP (DT a) (NN book))))
+(S (NP (DT the) (JJ big) (NN dog)) (VP (VBD chased) (NP (DT the) (JJ small) (NN cat))))
+(S (NP (DT a) (JJ happy) (NN child)) (VP (VBD found) (NP (DT the) (JJ red) (NN ball))))
+(S (NP (DT the) (JJ old) (NN man)) (VP (VBD read) (NP (DT the) (JJ old) (NN book))))
+(S (NP (PRP he)) (VP (VBD saw) (NP (DT the) (NN cat))))
+(S (NP (PRP she)) (VP (VBD liked) (NP (DT the) (NN dog))))
+(S (NP (PRP they)) (VP (VBD watched) (NP (DT the) (NN bird))))
+(S (NP (PRP he)) (VP (VBD walked) (PP (IN near) (NP (DT the) (NN house)))))
+(S (NP (NNP mary)) (VP (VBD saw) (NP (NNP john))))
+(S (NP (NNP john)) (VP (VBD liked) (NP (NNP mary))))
+(S (NP (NNP mary)) (VP (VBD gave) (NP (NNP john)) (NP (DT a) (NN book))))
+(S (NP (DT the) (NN cat)) (VP (VBD saw) (NP (NP (DT a) (NN dog)) (PP (IN in) (NP (DT the) (NN park))))))
+(S (NP (DT the) (NN bird)) (VP (VBD found) (NP (NP (DT a) (NN fish)) (PP (IN near) (NP (DT the) (NN house))))))
+(S (NP (DT the) (JJ small) (NN bird)) (VP (VBD sat) (PP (IN on) (NP (DT the) (JJ big) (NN tree)))))
+(S (NP (NP (DT the) (NN cat)) (PP (IN under) (NP (DT the) (NN house)))) (VP (VBD watched) (NP (DT the) (NN fish))))
+"""
+
+
+def bundled_treebank() -> list[Tree]:
+    """The sample trees (raw, n-ary, with POS preterminals)."""
+    return [
+        parse_ptb(line.strip())
+        for line in _TREEBANK.strip().splitlines()
+        if line.strip()
+    ]
+
+
+class Pcfg:
+    """Maximum-likelihood PCFG over binarized trees.
+
+    Rules: binary ``A -> B C`` (log prob) and lexical ``T -> word``.
+    Unknown words score against an open-class back-off distribution
+    built from singleton (hapax) tag counts.
+    """
+
+    def __init__(self):
+        self.binary: dict[tuple[str, str], list[tuple[str, float]]] = {}
+        self.lexical: dict[str, list[tuple[str, float]]] = {}
+        self.unk: list[tuple[str, float]] = []
+        self.root_labels: Counter = Counter()
+
+    @classmethod
+    def from_trees(cls, trees: list[Tree]) -> "Pcfg":
+        g = cls()
+        rule_counts: Counter = Counter()
+        lhs_counts: Counter = Counter()
+        lex_counts: Counter = Counter()
+        tag_counts: Counter = Counter()
+        word_freq: Counter = Counter()
+
+        prepared = [binarize(collapse_unaries(t)) for t in trees]
+        for t in prepared:
+            g.root_labels[t.label] += 1
+
+        def walk(node: Tree):
+            # a preterminal in this Tree convention is a LEAF carrying
+            # label (the POS tag) + word — parse_ptb builds (DT the) as
+            # Tree(label='DT', word='the') with no children
+            if node.is_leaf():
+                if node.word is not None:
+                    w = node.word.lower()
+                    lex_counts[(node.label, w)] += 1
+                    tag_counts[node.label] += 1
+                    word_freq[w] += 1
+                return
+            if len(node.children) == 1:
+                # unary-over-preterminal survives collapse_unaries (it
+                # stops at preterminals); fold the chain into the
+                # lexicon — the word is tagged with the chain's top
+                # label (e.g. NP -> (PRP he) teaches 'he' as NP)
+                w = node.children[0].word.lower()
+                lex_counts[(node.label, w)] += 1
+                tag_counts[node.label] += 1
+                word_freq[w] += 1
+                return
+            assert len(node.children) == 2, "binarize() guarantees arity 2"
+            b, c = node.children
+            rule_counts[(node.label, b.label, c.label)] += 1
+            lhs_counts[node.label] += 1
+            for ch in node.children:
+                walk(ch)
+
+        for t in prepared:
+            walk(t)
+
+        for (a, b, c), n in rule_counts.items():
+            g.binary.setdefault((b, c), []).append(
+                (a, math.log(n / lhs_counts[a]))
+            )
+        by_word: dict[str, list[tuple[str, float]]] = defaultdict(list)
+        for (tag, w), n in lex_counts.items():
+            by_word[w].append((tag, math.log(n / tag_counts[tag])))
+        g.lexical = dict(by_word)
+        # unknown-word back-off: every observed preterminal tag,
+        # weighted by frequency. (Hapax-based open-class estimation is
+        # the classic choice but too sparse for a mini-treebank — with
+        # ~30 trees whole tag classes have no singleton words.)
+        pool = tag_counts
+        total = sum(pool.values())
+        g.unk = [
+            (tag, math.log(n / total) - 2.0)  # -2.0: unk penalty
+            for tag, n in pool.items()
+        ]
+        return g
+
+
+class CkyParser:
+    """Exact Viterbi CKY over a :class:`Pcfg` (binarized grammar)."""
+
+    def __init__(self, grammar: Pcfg):
+        self.g = grammar
+
+    def parse(self, tokens: list[str]) -> Tree | None:
+        """Best parse as a binary tree (with the binarization's @labels
+        intact — downstream consumers train on binarized trees anyway),
+        or None when the grammar cannot span the sentence."""
+        n = len(tokens)
+        if n == 0:
+            return None
+        g = self.g
+        # chart[(i, j)] : label -> (logprob, backpointer)
+        chart: list[dict[str, tuple[float, object]]] = [
+            {} for _ in range(n * n)
+        ]
+
+        def cell(i, j):
+            return chart[i * n + (j - 1)]
+
+        for i, tok in enumerate(tokens):
+            w = tok.lower()
+            entries = g.lexical.get(w, g.unk)
+            c = cell(i, i + 1)
+            for tag, lp in entries:
+                if lp > c.get(tag, (-math.inf, None))[0]:
+                    c[tag] = (lp, tok)
+        for span in range(2, n + 1):
+            for i in range(0, n - span + 1):
+                j = i + span
+                c = cell(i, j)
+                for k in range(i + 1, j):
+                    left, right = cell(i, k), cell(k, j)
+                    if not left or not right:
+                        continue
+                    for bl, (blp, _) in left.items():
+                        for cl, (clp, _) in right.items():
+                            for a, rlp in g.binary.get((bl, cl), ()):
+                                p = blp + clp + rlp
+                                if p > c.get(a, (-math.inf, None))[0]:
+                                    c[a] = (p, (k, bl, cl))
+        top = cell(0, n)
+        best = None
+        for label in top:
+            bonus = 0.0 if g.root_labels.get(label) else -5.0
+            score = top[label][0] + bonus
+            if best is None or score > best[1]:
+                best = (label, score)
+        if best is None:
+            return None
+
+        def build(i, j, label) -> Tree:
+            lp, back = cell(i, j)[label]
+            if isinstance(back, str):
+                # preterminal = leaf with tag + word (parse_ptb convention)
+                return Tree(label=label, word=back)
+            k, bl, cl = back
+            return Tree(
+                label=label,
+                children=[build(i, k, bl), build(k, j, cl)],
+            )
+
+        return build(0, n, best[0])
+
+
+@functools.lru_cache(maxsize=1)
+def default_parser() -> CkyParser:
+    """Parser trained on the bundled treebank (built once per process)."""
+    return CkyParser(Pcfg.from_trees(bundled_treebank()))

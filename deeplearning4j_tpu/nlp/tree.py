@@ -119,12 +119,24 @@ def collapse_unaries(tree: Tree) -> Tree:
 
 
 class TreeVectorizer:
-    """Sentences -> binarized trees (≙ TreeVectorizer over TreeParser)."""
+    """Sentences -> binarized trees (≙ TreeVectorizer over TreeParser).
 
-    def __init__(self, tokenizer=None):
+    Raw text goes through the PCFG-CKY parser
+    (:mod:`deeplearning4j_tpu.nlp.parser`, ≙ TreeParser's OpenNLP
+    constituency model); sentences outside the grammar fall back to the
+    right-branching tree so every sentence still yields a binary tree.
+    Pass ``parser=None, use_pcfg=False`` to force the fallback.
+    """
+
+    def __init__(self, tokenizer=None, parser=None, use_pcfg: bool = True):
         from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizer
 
         self.tokenizer = tokenizer or DefaultTokenizer()
+        if parser is None and use_pcfg:
+            from deeplearning4j_tpu.nlp.parser import default_parser
+
+            parser = default_parser()
+        self.parser = parser
 
     def trees(self, text: str) -> list[Tree]:
         from deeplearning4j_tpu.nlp.tokenization import split_sentences
@@ -132,6 +144,10 @@ class TreeVectorizer:
         out = []
         for sent in split_sentences(text):
             toks = self.tokenizer.tokens(sent)
-            if toks:
-                out.append(binarize(right_branching_tree(toks)))
+            if not toks:
+                continue
+            tree = self.parser.parse(toks) if self.parser else None
+            if tree is None:
+                tree = binarize(right_branching_tree(toks))
+            out.append(tree)
         return out

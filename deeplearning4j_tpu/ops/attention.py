@@ -15,15 +15,30 @@ import jax
 import jax.numpy as jnp
 
 
-def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False) -> jax.Array:
-    """Reference dense attention. q,k,v: (B, T, H, D) -> (B, T, H, D)."""
+def attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
+    layout: str = "bthd",
+) -> jax.Array:
+    """Reference dense attention.
+
+    ``layout="bthd"``: q,k,v (B, T, H, D) -> (B, T, H, D) (default).
+    ``layout="bhtd"``: q,k,v (B, H, T, D) -> (B, H, T, D) — heads-major,
+    avoids physical transposes when the caller already carries that
+    layout (the transformer block does).
+    """
     d = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if layout == "bhtd":
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    logits = logits / jnp.sqrt(d).astype(q.dtype)
     if causal:
         t_q, t_k = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((t_q, t_k), bool))
         logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
+    if layout == "bhtd":
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 

@@ -100,8 +100,13 @@ def _flash_fwd_stream_kernel(
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m_prev - m_new)
         l_s[:, 0] = corr * l_s[:, 0] + jnp.sum(p, axis=-1)
+        # PV dot with p cast to the value dtype (bf16 on TPU): operands
+        # must stay low-precision to hit the MXU at full rate — an f32
+        # matmul runs at a fraction of peak on v5e. The accumulator is
+        # f32 (preferred_element_type + f32 scratch), the standard
+        # flash-bf16 recipe.
         acc_s[:] = corr[:, None] * acc_s[:] + jnp.dot(
-            p, v_ref[0].astype(jnp.float32),
+            p.astype(v_ref.dtype), v_ref[0],
             preferred_element_type=jnp.float32,
         )
         m_s[:, 0] = m_new
@@ -172,18 +177,21 @@ def _flash_bwd_dq_kernel(
         dq_s[:] = jnp.zeros_like(dq_s)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # operands stay in their storage dtype (bf16 on TPU) — only the
+        # accumulation is f32 (preferred_element_type); f32 matmul
+        # operands would fall off the MXU fast path
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = s + _causal_bias(q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(k_blk.dtype)
         dq_s[:] = dq_s[:] + jnp.dot(
             ds, k_blk, preferred_element_type=jnp.float32
         ) * scale
@@ -220,10 +228,11 @@ def _flash_bwd_dkv_kernel(
         dv_s[:] = jnp.zeros_like(dv_s)
 
     def compute():
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 operands + f32 accumulation, as in the dq kernel
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
@@ -231,10 +240,10 @@ def _flash_bwd_dkv_kernel(
             s = s + _causal_bias(q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dv_s[:] = dv_s[:] + jnp.dot(
-            p.T, do, preferred_element_type=jnp.float32
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_s[:] = dk_s[:] + jnp.dot(
             ds.T, q, preferred_element_type=jnp.float32
         ) * scale
@@ -364,24 +373,34 @@ def flash_attention_trainable(
     block_k: int = 128,
     interpret: bool | None = None,
     causal: bool = False,
+    layout: str = "bthd",
 ) -> jax.Array:
-    """Differentiable flash attention: (B, T, H, D) in and out.
+    """Differentiable flash attention: (B, T, H, D) in and out
+    (``layout="bhtd"``: (B, H, T, D) in and out — a free reshape into
+    the kernel's (B*H, T, D) view, no physical transpose).
 
     Forward saves only O and the per-row logsumexp; the backward pass is
     two more pallas kernels (dQ; dK/dV) that stream blocks and recompute
     probabilities — O(T) memory instead of the T x T attention matrix that
     plain autodiff through dense attention would save.
     """
-    b, t, h, d = q.shape
+    if layout == "bhtd":
+        b, h, t, d = q.shape
+    else:
+        b, t, h, d = q.shape
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     assert t % block_q == 0 and t % block_k == 0
     interpret = (not _on_tpu()) if interpret is None else interpret
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    if layout == "bhtd":
+        qf, kf, vf = (a.reshape(b * h, t, d) for a in (q, k, v))
+    else:
+        qf, kf, vf = (
+            a.transpose(0, 2, 1, 3).reshape(b * h, t, d) for a in (q, k, v)
+        )
     out = _flash_bhtd(qf, kf, vf, block_q, block_k, interpret, causal)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, t, d)
+    return out if layout == "bhtd" else out.transpose(0, 2, 1, 3)
 
 
 # -- fused embedding dot (word2vec HS read side) ------------------------------

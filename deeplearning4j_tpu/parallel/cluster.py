@@ -52,6 +52,10 @@ class ClusterService:
         self.patience = 5
         self.patience_counter = 0
         self.early_stop = False
+        # human-readable model summary served at GET /statetracker/
+        # printmodel (≙ StateTrackerDropWizardResource.printModel); the
+        # trainer sets it
+        self.model_description = ""
         self._server: ThreadingHTTPServer | None = None
 
     # -- worker registry / heartbeats -------------------------------------
@@ -104,28 +108,84 @@ class ClusterService:
 
     # -- REST (≙ StateTrackerDropWizardResource) ---------------------------
     def start_rest_api(self, port: int = 0) -> int:
+        """GET status + POST *control*, matching the reference resource
+        (StateTrackerDropWizardResource.java:29-96: GET jobs/phase/
+        minibatch/printmodel, POST minibatch). POSTs change live trainer
+        behavior: the training loop reads ``minibatch`` each step and
+        ``early_stop`` on its report cadence."""
         service = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from deeplearning4j_tpu.utils.httpjson import (
+            QuietHandler,
+            read_json_body,
+            send_json,
+        )
+
+        class Handler(QuietHandler):
+            def _json(self, code, payload):
+                send_json(self, code, payload)
+
             def do_GET(self):  # noqa: N802
                 parts = self.path.strip("/").split("/")
                 status = service.status()
                 if len(parts) == 2 and parts[0] == "statetracker":
+                    if parts[1] == "printmodel":
+                        return self._json(
+                            200, {"model": service.model_description}
+                        )
                     payload = status.get(parts[1])
                     if payload is None:
-                        self.send_response(404)
-                        self.end_headers()
-                        return
+                        return self._json(404, {"error": "unknown field"})
                 else:
                     payload = status
-                body = json.dumps(payload).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.end_headers()
-                self.wfile.write(body)
+                self._json(200, payload)
 
-            def log_message(self, *a):  # silence
-                pass
+            def do_POST(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                req = read_json_body(self)
+                if req is None:
+                    return self._json(400, {"error": "bad json"})
+                if len(parts) != 2 or parts[0] != "statetracker":
+                    return self._json(404, {"error": "unknown endpoint"})
+                if parts[1] == "minibatch":
+                    # ≙ POST /statetracker/minibatch (runtime batch-size
+                    # control). Bounded: a fat-fingered value must not
+                    # be able to OOM-kill the live training process.
+                    try:
+                        value = int(req["value"])
+                    except (KeyError, TypeError, ValueError):
+                        return self._json(400, {"error": "need int value"})
+                    if not 1 <= value <= 1_000_000:
+                        return self._json(
+                            400,
+                            {"error": "minibatch out of range [1, 1e6]"},
+                        )
+                    service.minibatch = value
+                    return self._json(200, {"minibatch": service.minibatch})
+                if parts[1] == "earlystop":
+                    service.early_stop = True
+                    return self._json(200, {"earlystop": True})
+                if parts[1] == "heartbeat":
+                    # cross-process worker heartbeat (≙ WorkerActor
+                    # .heartbeat:152-170 re-registering with the master)
+                    wid = req.get("worker")
+                    if not wid:
+                        return self._json(400, {"error": "need worker"})
+                    meta = req.get("meta", {})
+                    if not isinstance(meta, dict):
+                        return self._json(400, {"error": "meta must be "
+                                                "an object"})
+                    # drop keys that would collide with the positional
+                    # worker_id parameter of heartbeat(**meta)
+                    meta = {
+                        k: v for k, v in meta.items() if k != "worker_id"
+                    }
+                    service.heartbeat(str(wid), **meta)
+                    return self._json(200, {"workers": service.workers()})
+                if parts[1] == "phase":
+                    service.phase = str(req.get("value", service.phase))
+                    return self._json(200, {"phase": service.phase})
+                return self._json(404, {"error": "unknown endpoint"})
 
         self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         thread = threading.Thread(target=self._server.serve_forever, daemon=True)

@@ -109,11 +109,46 @@ class DataParallelTrainer:
             step=jnp.zeros((), jnp.int32),
         )
         repl = NamedSharding(self.mesh, P())
-        return jax.device_put(state, repl)
+        if jax.process_count() == 1:
+            return jax.device_put(state, repl)
+        # multi-process: device_put cannot address remote shards; build
+        # each (replicated) leaf from the process-local value instead.
+        # Every process computed identical params (same seed), which is
+        # exactly the replication invariant.
+        import numpy as np
+
+        def mk(a):
+            a = np.asarray(a)
+            return jax.make_array_from_callback(
+                a.shape, repl, lambda idx: a[idx]
+            )
+
+        return jax.tree.map(mk, state)
 
     def shard_batch(self, x, y):
         shard = NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS))
         return jax.device_put(x, shard), jax.device_put(y, shard)
+
+    def shard_global_batch(self, x, y):
+        """Multi-process-safe :meth:`shard_batch`: every process passes
+        the same GLOBAL batch; each materializes only the shards its
+        local devices own (``jax.make_array_from_callback``). In a
+        single-process mesh this is equivalent to :meth:`shard_batch`;
+        under ``jax.distributed`` it is the only correct construction —
+        ``device_put`` of a host array onto a global sharding would try
+        to address other processes' devices.
+        """
+        import numpy as np
+
+        shard = NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS))
+
+        def mk(a):
+            a = np.asarray(a)
+            return jax.make_array_from_callback(
+                a.shape, shard, lambda idx: a[idx]
+            )
+
+        return mk(x), mk(y)
 
     def step(self, state: TrainState, x, y, key) -> tuple[TrainState, jax.Array]:
         return self._step(state, x, y, key)

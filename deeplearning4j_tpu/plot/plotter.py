@@ -109,12 +109,70 @@ class PlotterIterationListener(IterationListener):
             )
 
 
+# self-contained scatter page: pan/zoom canvas, hover labels, no external
+# assets (the reference ships a jquery+highcharts bundle under
+# deeplearning4j-nlp/src/main/resources/assets; offline here, so inline JS)
+_TSNE_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>t-SNE — deeplearning4j_tpu</title>
+<style>
+ body{margin:0;font:13px system-ui,sans-serif;background:#111;color:#ddd}
+ #hud{position:fixed;top:8px;left:12px;pointer-events:none}
+ canvas{display:block;cursor:grab}
+</style></head><body>
+<div id="hud">t-SNE render — drag to pan, wheel to zoom, hover for words</div>
+<canvas id="c"></canvas>
+<script>
+const cv=document.getElementById('c'),cx=cv.getContext('2d');
+let pts=[],tx=0,ty=0,scale=1,drag=null,hover=-1;
+function resize(){cv.width=innerWidth;cv.height=innerHeight;draw()}
+function sx(p){return (p.x-mid.x)*base*scale+cv.width/2+tx}
+function sy(p){return (p.y-mid.y)*base*scale+cv.height/2+ty}
+let mid={x:0,y:0},base=1;
+function fit(){
+ if(!pts.length)return;
+ const xs=pts.map(p=>p.x),ys=pts.map(p=>p.y);
+ const w=Math.max(...xs)-Math.min(...xs)||1,h=Math.max(...ys)-Math.min(...ys)||1;
+ mid={x:(Math.max(...xs)+Math.min(...xs))/2,y:(Math.max(...ys)+Math.min(...ys))/2};
+ base=0.9*Math.min(cv.width/w,cv.height/h);
+}
+function draw(){
+ cx.clearRect(0,0,cv.width,cv.height);
+ pts.forEach((p,i)=>{
+  cx.fillStyle=i===hover?'#ff5':'#6cf';
+  cx.beginPath();cx.arc(sx(p),sy(p),i===hover?5:2.5,0,7);cx.fill();
+  if(scale>2.5||i===hover){cx.fillStyle=i===hover?'#ff5':'#9ab';
+   cx.fillText(p.word,sx(p)+6,sy(p)+4);}
+ });
+}
+cv.onmousedown=e=>{drag={x:e.clientX-tx,y:e.clientY-ty};cv.style.cursor='grabbing'};
+onmouseup=()=>{drag=null;cv.style.cursor='grab'};
+onmousemove=e=>{
+ if(drag){tx=e.clientX-drag.x;ty=e.clientY-drag.y;draw();return}
+ let best=-1,bd=144;
+ pts.forEach((p,i)=>{const d=(sx(p)-e.clientX)**2+(sy(p)-e.clientY)**2;
+  if(d<bd){bd=d;best=i}});
+ if(best!==hover){hover=best;draw()}
+};
+onwheel=e=>{scale*=e.deltaY<0?1.15:1/1.15;draw()};
+onresize=resize;
+fetch('/coords').then(r=>r.json()).then(d=>{pts=d;resize();fit();draw()});
+resize();
+</script></body></html>"""
+
+
 def serve_tsne(words: list[str], coords: np.ndarray, port: int = 0) -> int:
-    """Tiny render endpoint serving t-SNE coords as JSON
-    (≙ plot/dropwizard RenderApplication.java:53 + ApiResource.java:65)."""
+    """Browsable t-SNE render server.
+
+    ≙ the reference's dropwizard render webapp (plot/dropwizard/
+    RenderApplication.java:53 serving ApiResource coords + a JS scatter
+    under nlp resources/assets): ``GET /`` returns a self-contained
+    HTML/canvas scatter page (pan/zoom/hover), ``GET /coords`` the
+    [{word, x, y}] JSON the page fetches."""
     import json
     import threading
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from http.server import ThreadingHTTPServer
+
+    from deeplearning4j_tpu.utils.httpjson import QuietHandler, send_body
 
     payload = json.dumps(
         [
@@ -122,16 +180,18 @@ def serve_tsne(words: list[str], coords: np.ndarray, port: int = 0) -> int:
             for w, (x, y) in zip(words, np.asarray(coords))
         ]
     ).encode()
+    page = _TSNE_PAGE.encode()
 
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(QuietHandler):
         def do_GET(self):  # noqa: N802
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.end_headers()
-            self.wfile.write(payload)
-
-        def log_message(self, *a):
-            pass
+            if self.path in ("/", "/index.html"):
+                send_body(self, 200, page, "text/html; charset=utf-8")
+            elif self.path == "/coords":
+                send_body(self, 200, payload, "application/json")
+            else:
+                # unknown paths (favicon.ico, typos) must not ship the
+                # whole coords payload
+                send_body(self, 404, b"{}", "application/json")
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()

@@ -1,0 +1,111 @@
+"""Worker process for the 2-process jax.distributed test.
+
+Run as: python tests/distributed_worker.py <registry_addr> <job_id> <pid> <nprocs>
+
+Boot sequence (≙ the reference's DeepLearning4jDistributed bootstrap,
+DeepLearning4jDistributed.java:48, with ZooKeeper discovery
+≙ ZooKeeperConfigurationRegister.java:40):
+- process 0 registers the jax.distributed coordinator address in the
+  network registry; the other processes retrieve it — the ONLY shared
+  state is the registry address (no shared filesystem);
+- every process calls jax.distributed.initialize and registers itself as
+  an (ephemeral) worker;
+- all processes run the same SPMD program: a DataParallelTrainer step
+  over the global (nprocs x local_devices) mesh;
+- each prints its final loss as LOSS=<float> for the test to compare.
+
+The device topology is pinned BEFORE jax import: 4 virtual CPU devices
+per process, so 2 processes reproduce the 8-device mesh the
+single-process suite uses.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    .replace("--xla_force_host_platform_device_count=8", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    registry_addr, job_id, pid_s, nprocs_s = sys.argv[1:5]
+    pid, nprocs = int(pid_s), int(nprocs_s)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+
+    from deeplearning4j_tpu.parallel.registry import NetworkRegistry
+
+    reg = NetworkRegistry(registry_addr, job_id)
+    if pid == 0:
+        # the coordinator picks a free port and publishes it
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        coordinator = f"127.0.0.1:{port}"
+        reg.register_master({"coordinator": coordinator, "nprocs": nprocs})
+    else:
+        coordinator = reg.retrieve_master(timeout=60.0)["coordinator"]
+
+    from deeplearning4j_tpu.parallel.cluster import initialize_distributed
+
+    initialize_distributed(
+        coordinator=coordinator, num_processes=nprocs, process_id=pid
+    )
+    reg.register_worker(str(pid), {"devices": jax.local_device_count()})
+
+    assert jax.device_count() == 4 * nprocs, jax.device_count()
+    assert jax.process_count() == nprocs
+
+    # the same tiny MLP training run as the single-process reference in
+    # the test — identical seeds, identical global batch
+    import jax.numpy as jnp
+    import optax
+
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+    w_rng = np.random.default_rng(1)
+    params = {
+        "w1": jnp.asarray(w_rng.normal(size=(8, 16)).astype(np.float32) * 0.3),
+        "b1": jnp.zeros((16,)),
+        "w2": jnp.asarray(w_rng.normal(size=(16, 4)).astype(np.float32) * 0.3),
+        "b2": jnp.zeros((4,)),
+    }
+
+    def loss_fn(p, xb, yb, key=None):
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        return optax.softmax_cross_entropy(logits, yb).mean()
+
+    mesh = mesh_lib.data_parallel_mesh(jax.device_count())
+    trainer = DataParallelTrainer(
+        loss_fn, mesh=mesh, optimizer=optax.sgd(0.1)
+    )
+    state = trainer.init(params)
+    xs, ys = trainer.shard_global_batch(x, y)
+    loss = None
+    for i in range(20):
+        state, loss = trainer.step(state, xs, ys, jax.random.key(0))
+    print(f"WORKERS={','.join(reg.list_workers())}", flush=True)
+    print(f"LOSS={float(loss):.10f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ CFG = TransformerConfig(
 )
 
 
+@pytest.mark.slow
 def test_async_sharded_save_restore_roundtrip(devices, tmp_path):
     mesh = mesh_lib.dp_mp_mesh(4, 2)
     params = place_transformer_params(

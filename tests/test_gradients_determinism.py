@@ -56,6 +56,7 @@ def test_dense_output_gradcheck(activation):
     assert rel.max() < 2e-2, rel.max()
 
 
+@pytest.mark.slow
 def test_lstm_bptt_gradcheck():
     mod = L.get("lstm")
     v = 4

@@ -137,6 +137,7 @@ def test_serde_roundtrip():
     assert np.allclose(np.asarray(net.output(x)), np.asarray(net2.output(x)), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_conv_network_lenet_style():
     """Conv+pool -> dense -> softmax on synthetic MNIST; the trainable conv
     net the reference never finished (its conv layer was forward-only)."""

@@ -61,6 +61,7 @@ def test_topo_pack_children_before_parents():
             assert left[i] < i and right[i] < i
 
 
+@pytest.mark.slow
 def test_rntn_learns_sentiment():
     """Tiny sentiment task: label 1 trees contain 'good', label 0 'bad'."""
     rng = np.random.default_rng(0)

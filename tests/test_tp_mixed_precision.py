@@ -1,6 +1,7 @@
 """Tensor-parallel block parity, remat trainer, mixed-precision policy,
 and the full driver dryrun entry."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -97,6 +98,7 @@ def test_mixed_bf16_loss_runs_in_accum_dtype():
         assert np.isfinite(l).all() and l[-1] < l[0] * 0.5
 
 
+@pytest.mark.slow
 def test_graft_dryrun_multichip(devices):
     import importlib.util
 

@@ -57,6 +57,7 @@ def test_tp_sharded_forward_matches_replicated(devices):
     )
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     cfg_r = TransformerConfig(**{
         **CFG.__dict__, "remat": True
@@ -72,6 +73,7 @@ def test_remat_matches_no_remat():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_composed_dp_tp_training_learns(devices):
     mesh = mesh_lib.dp_mp_mesh(2, 4)
     step, init_state, shard_tokens = transformer_train_step(mesh, CFG)
@@ -89,6 +91,7 @@ def _cfg(**over):
     return TransformerConfig(**{**CFG.__dict__, **over})
 
 
+@pytest.mark.slow
 def test_moe_transformer_training_learns(devices):
     mesh = mesh_lib.dp_mp_mesh(2, 4)
     cfg = _cfg(n_experts=4, moe_capacity_factor=4.0)
@@ -133,6 +136,7 @@ def test_sequence_parallel_matches_dense(devices):
     )
 
 
+@pytest.mark.slow
 def test_sp_moe_composed_train_step(devices):
     # sp x tp x ep in one step: sequence ring over data, heads + experts
     # over model
@@ -146,6 +150,7 @@ def test_sp_moe_composed_train_step(devices):
         assert np.isfinite(float(l))
 
 
+@pytest.mark.slow
 def test_fsdp_training_matches_replicated(devices):
     # ZeRO-3 layout: params + optimizer state sharded over the data axis;
     # must train identically (up to reduction reorder) to the plain layout
@@ -173,6 +178,7 @@ def test_fsdp_training_matches_replicated(devices):
     np.testing.assert_allclose(losses[False], losses[True], rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_full_forward():
     from deeplearning4j_tpu.models.transformer import transformer_generate
 
@@ -193,6 +199,7 @@ def test_greedy_generate_matches_full_forward():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.slow
 def test_sampled_generate_is_deterministic_per_key_and_respects_top_k():
     from deeplearning4j_tpu.models.transformer import transformer_generate
 
@@ -214,6 +221,7 @@ def test_sampled_generate_is_deterministic_per_key_and_respects_top_k():
     np.testing.assert_array_equal(np.asarray(g1), np.asarray(greedy))
 
 
+@pytest.mark.slow
 def test_moe_generate_matches_full_forward(devices):
     # the decode path's per-token MoE must run the SAME model (activation
     # included) as the trained moe_ffn path
@@ -248,6 +256,19 @@ def test_flash_attention_transformer_matches_dense():
     # gradients flow through the custom-vjp flash backward
     g = jax.grad(transformer_loss(cfg_flash))(params, _tokens(2, 17, seed=41))
     assert all(np.isfinite(np.asarray(a)).all() for a in jax.tree.leaves(g))
+
+
+def test_flash_block_sizes_divide_any_legal_seq_len():
+    """T only has to be a multiple of 128 — the block-size picker must
+    not hand the kernel a block that doesn't divide T (T=1536 crashed
+    when blocks were hardcoded 512/1024)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(_cfg(use_flash=True), max_len=1537)
+    params = init_transformer(jax.random.key(41), cfg)
+    toks = _tokens(1, 1536, seed=42)
+    y, _ = transformer_apply(cfg)(params, toks)
+    assert np.isfinite(np.asarray(y)).all()
 
 
 def test_beam_search_width1_equals_greedy():
@@ -309,6 +330,7 @@ def test_bf16_compute_runs_and_is_close():
     assert float(jnp.mean(jnp.abs(y32 - y16))) < 0.1
 
 
+@pytest.mark.slow
 def test_rope_causality_and_decode_parity():
     from deeplearning4j_tpu.models.transformer import transformer_generate
 
@@ -370,6 +392,7 @@ def test_rope_rejects_odd_head_dim():
         transformer_apply(cfg)
 
 
+@pytest.mark.slow
 def test_gqa_forward_decode_and_tp_parity(devices):
     from deeplearning4j_tpu.models.transformer import transformer_generate
 
@@ -406,6 +429,7 @@ def test_gqa_forward_decode_and_tp_parity(devices):
     )
 
 
+@pytest.mark.slow
 def test_gqa_training_learns(devices):
     mesh = mesh_lib.dp_mp_mesh(4, 2)
     cfg = _cfg(n_kv_heads=2)
@@ -441,6 +465,7 @@ def test_mqa_tp_replicated_kv(devices):
     )
 
 
+@pytest.mark.slow
 def test_lm_optimizer_trains_with_warmup_and_clipping(devices):
     from deeplearning4j_tpu.models.transformer import lm_optimizer
 

@@ -46,6 +46,7 @@ def test_char_lstm_learns_and_samples():
     assert beams and all(lp <= 0 for _, lp in beams)
 
 
+@pytest.mark.slow
 def test_alexnet_forward_and_one_step():
     net, params = build_alexnet(seed=0)
     ds = synthetic_cifar(16)
@@ -80,6 +81,7 @@ def test_cli_train_and_provision(tmp_path, capsys):
     assert "--zone=us-east1-d" in out
 
 
+@pytest.mark.slow
 def test_cli_train_transformer_tp_orbax(tmp_path, capsys):
     from deeplearning4j_tpu.cli import main
 
