@@ -252,12 +252,19 @@ def _apply_rope(x, cos, sin):
     )
 
 
-def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
+def transformer_apply(
+    cfg: TransformerConfig, mesh: Mesh | None = None,
+    upcast_logits: bool = True,
+):
     """Build apply(params, tokens) -> (logits (B, T, V), aux_loss), causal.
 
     ``mesh`` is required for the MoE (``cfg.n_experts``) and
     ``cfg.sequence_parallel`` modes — both embed shard_map collectives
     inside the jitted forward; the dense/dp-only model needs no mesh.
+    ``upcast_logits=False`` returns logits in the compute dtype — the
+    training path pairs it with the fused CE
+    (:mod:`deeplearning4j_tpu.ops.fused_ce`) so no f32 (B, T, V) copy is
+    ever materialized.
     """
     if (cfg.n_experts or cfg.sequence_parallel) and mesh is None:
         raise ValueError("MoE / sequence-parallel modes need a mesh")
@@ -435,15 +442,22 @@ def transformer_apply(cfg: TransformerConfig, mesh: Mesh | None = None):
         logits = jnp.einsum(
             "btd,dv->btv", x, params["head"].astype(x.dtype)
         )
-        return logits.astype(jnp.float32), jnp.sum(aux.astype(jnp.float32))
+        if upcast_logits:
+            logits = logits.astype(jnp.float32)
+        return logits, jnp.sum(aux.astype(jnp.float32))
 
     return apply
 
 
 def transformer_loss(cfg: TransformerConfig, mesh: Mesh | None = None):
     """Next-token cross-entropy (+ MoE aux term): loss(params, tokens)
-    with tokens (B, T+1)."""
-    apply = transformer_apply(cfg, mesh)
+    with tokens (B, T+1). Uses the memory-fused CE on compute-dtype
+    logits — no f32 (B, T, V) materialization in either direction."""
+    from deeplearning4j_tpu.ops.fused_ce import (
+        cross_entropy_with_integer_labels,
+    )
+
+    apply = transformer_apply(cfg, mesh, upcast_logits=False)
 
     if cfg.sequence_parallel:
         # keep the model's T equal to the (shard-divisible) input length:
@@ -453,16 +467,14 @@ def transformer_loss(cfg: TransformerConfig, mesh: Mesh | None = None):
             b, t = tokens.shape
             logits, aux = apply(params, tokens)
             targets = jnp.roll(tokens, -1, axis=1)
-            ce_tok = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets
-            )
+            ce_tok = cross_entropy_with_integer_labels(logits, targets)
             mask = (jnp.arange(t) < t - 1).astype(ce_tok.dtype)[None, :]
             ce = jnp.sum(ce_tok * mask) / (jnp.sum(mask) * b)
             return ce + cfg.aux_coef * aux
     else:
         def loss(params, tokens):
             logits, aux = apply(params, tokens[:, :-1])
-            ce = optax.softmax_cross_entropy_with_integer_labels(
+            ce = cross_entropy_with_integer_labels(
                 logits, tokens[:, 1:]
             ).mean()
             return ce + cfg.aux_coef * aux

@@ -79,13 +79,23 @@ def main():
     print(f"DP AllReduce: loss {float(losses[0]):.4f} -> "
           f"{float(losses[-1]):.4f}")
 
-    # -- mode 2: local SGD + parameter averaging --------------------------
-    params, loss_fn = build_model()
-    step = local_sgd_step(loss_fn, mesh, local_steps=4, lr=0.05)
+    # -- mode 2: local SGD + parameter averaging on a CNN -----------------
+    # ≙ the north-star "Spark parameter-averaging distributed CNN"
+    # config: each of the 8 devices runs k local steps of LeNet on its
+    # shard, then parameters are pmean'd — one shard_map program per
+    # round, no actor round-trips
+    from deeplearning4j_tpu.models.lenet import build_lenet, lenet_loss
+
+    net, cnn_params = build_lenet(seed=0)
+    ds2 = fetchers.mnist(n=64)
+    cx = jnp.asarray(ds2.features)
+    cy = jnp.asarray(ds2.labels)
+    step = local_sgd_step(lenet_loss(net), mesh, local_steps=4, lr=0.05)
     loss = None
-    for i in range(50):  # 50 rounds x 4 local steps
-        params, loss = step(params, x, y, jax.random.key(i))
-    print(f"local SGD (k=4 averaging rounds): final loss {float(loss):.4f}")
+    for i in range(25):  # 25 rounds x 4 local steps
+        cnn_params, loss = step(cnn_params, cx, cy, jax.random.key(i))
+    print(f"local-SGD CNN (k=4 averaging rounds): final loss "
+          f"{float(loss):.4f}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,59 @@
+"""Memory-fused softmax CE: value/gradient parity with optax."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.ops.fused_ce import cross_entropy_with_integer_labels
+
+
+def _data(dtype, b=4, t=8, v=50):
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 2.0, (b, t, v)), dtype)
+    targets = jnp.asarray(rng.integers(0, v, (b, t)), jnp.int32)
+    return logits, targets
+
+
+def test_matches_optax_f32_value_and_grad():
+    logits, targets = _data(jnp.float32)
+    ce = cross_entropy_with_integer_labels(logits, targets)
+    ref = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    np.testing.assert_allclose(np.asarray(ce), np.asarray(ref), rtol=1e-6)
+
+    g = jax.grad(lambda l: cross_entropy_with_integer_labels(l, targets).mean())(logits)
+    gr = jax.grad(
+        lambda l: optax.softmax_cross_entropy_with_integer_labels(l, targets).mean()
+    )(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-7)
+
+
+def test_bf16_logits_f32_loss_and_bf16_cotangent():
+    logits, targets = _data(jnp.bfloat16)
+    ce = cross_entropy_with_integer_labels(logits, targets)
+    assert ce.dtype == jnp.float32
+    ref = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    np.testing.assert_allclose(np.asarray(ce), np.asarray(ref), atol=1e-2)
+
+    g = jax.grad(
+        lambda l: cross_entropy_with_integer_labels(l, targets).mean()
+    )(logits)
+    assert g.dtype == jnp.bfloat16  # cotangent stays in storage dtype
+    gr = jax.grad(
+        lambda l: optax.softmax_cross_entropy_with_integer_labels(l, targets).mean()
+    )(logits.astype(jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(g, np.float32), np.asarray(gr), atol=2e-4
+    )
+
+
+def test_jits_and_handles_extreme_logits():
+    logits = jnp.asarray(
+        [[[1e4, -1e4, 0.0], [-1e4, -1e4, -1e4]]], jnp.float32
+    )
+    targets = jnp.asarray([[0, 2]], jnp.int32)
+    ce = jax.jit(cross_entropy_with_integer_labels)(logits, targets)
+    assert np.isfinite(np.asarray(ce)).all()
+    np.testing.assert_allclose(float(ce[0, 0]), 0.0, atol=1e-5)

@@ -1,0 +1,51 @@
+"""Porter stemmer (≙ StemmerAnnotator's Snowball stemming)."""
+
+import pytest
+
+from deeplearning4j_tpu.nlp.stemmer import PorterStemmer, porter_stem
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizer, lowercase
+
+# full-pipeline outputs of the original Porter (1980) algorithm
+VECTORS = {
+    "caresses": "caress", "ponies": "poni", "ties": "ti",
+    "caress": "caress", "cats": "cat", "feed": "feed", "agreed": "agre",
+    "plastered": "plaster", "motoring": "motor", "sing": "sing",
+    "happy": "happi", "sky": "sky", "generalizations": "gener",
+    "oscillators": "oscil", "university": "univers",
+    "universities": "univers", "running": "run", "runner": "runner",
+    "easily": "easili", "national": "nation", "nationality": "nation",
+    "determination": "determin", "conditional": "condit",
+    "effective": "effect", "hopping": "hop", "tanned": "tan",
+    "falling": "fall", "hissing": "hiss", "filing": "file",
+    "adjustable": "adjust", "replacement": "replac", "adoption": "adopt",
+    "argue": "argu", "argued": "argu", "arguing": "argu",
+}
+
+
+def test_porter_canonical_vectors():
+    for word, want in VECTORS.items():
+        assert porter_stem(word) == want, (word, porter_stem(word), want)
+
+
+def test_porter_matches_nltk_original_algorithm():
+    """Oracle cross-check against the reference implementation of the
+    original algorithm (skipped when nltk is absent)."""
+    nltk_stem = pytest.importorskip("nltk.stem.porter")
+    ref = nltk_stem.PorterStemmer(mode="ORIGINAL_ALGORITHM")
+    words = (
+        "the quick brown foxes were jumping over lazily sleeping dogs "
+        "relational conditional rational operations digitizer radically "
+        "hopefulness electrical revival allowance inference airliner "
+        "gyroscopic irritant dependent homologous communism activated "
+        "probate cease controlling rolled troubles troubling sensible "
+        "sensibility capabilities derivational derived derive derives"
+    ).split()
+    for w in words:
+        assert porter_stem(w) == ref.stem(w), w
+
+
+def test_stemmer_composes_as_tokenizer_preprocessor():
+    tok = DefaultTokenizer(preprocessors=(lowercase, PorterStemmer()))
+    assert tok.tokens("The Runners were RUNNING easily!") == [
+        "the", "runner", "were", "run", "easili",
+    ]
