@@ -252,6 +252,43 @@ def _apply_rope(x, cos, sin):
     )
 
 
+def _project_qkv(cfg: TransformerConfig, p, h_in):
+    """Shared QKV projection for all sequence-shaped forwards (training
+    block and bulk prefill): h_in (B, T, D) -> q (B, H, T, K) and the
+    UNexpanded k/v (B, H_kv, T, K). One implementation so GQA/MHA
+    layouts cannot drift between the paths."""
+    if cfg.kv_heads != cfg.n_heads:
+        q = jnp.einsum("btd,dhk->bhtk", h_in, p["wq"].astype(h_in.dtype))
+        kv = jnp.einsum(
+            "btd,dshk->sbhtk", h_in, p["wkv"].astype(h_in.dtype)
+        )
+        return q, kv[0], kv[1]
+    qkv = jnp.einsum(
+        "btd,dshk->sbhtk", h_in, p["wqkv"].astype(h_in.dtype)
+    )
+    return qkv[0], qkv[1], qkv[2]
+
+
+def _expand_kv(cfg: TransformerConfig, k_r, v_r):
+    """GQA group-repeat (no-op for MHA): (B, H_kv, T, K) -> (B, H, T, K)."""
+    g = cfg.n_heads // cfg.kv_heads
+    if g == 1:
+        return k_r, v_r
+    return jnp.repeat(k_r, g, axis=1), jnp.repeat(v_r, g, axis=1)
+
+
+def _mlp(p, h_in):
+    """Shared dense FFN (gelu) over (..., D) activations."""
+    h = jax.nn.gelu(
+        jnp.einsum("...d,df->...f", h_in, p["w1"].astype(h_in.dtype))
+        + p["b1"].astype(h_in.dtype)
+    )
+    return (
+        jnp.einsum("...f,fd->...d", h, p["w2"].astype(h_in.dtype))
+        + p["b2"].astype(h_in.dtype)
+    )
+
+
 def transformer_apply(
     cfg: TransformerConfig, mesh: Mesh | None = None,
     upcast_logits: bool = True,
@@ -308,19 +345,7 @@ def transformer_apply(
         # layout cost ~3ms/step of physical transposes at GPT-2-small
         # scale (B=16, T=1024)
         h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
-        if cfg.kv_heads != cfg.n_heads:
-            q_h = jnp.einsum("btd,dhk->bhtk", h_in, p["wq"].astype(x.dtype))
-            kv = jnp.einsum(
-                "btd,dshk->sbhtk", h_in, p["wkv"].astype(x.dtype)
-            )
-            g = cfg.n_heads // cfg.kv_heads
-            k_h = jnp.repeat(kv[0], g, axis=1)
-            v_h = jnp.repeat(kv[1], g, axis=1)
-        else:
-            qkv = jnp.einsum(
-                "btd,dshk->sbhtk", h_in, p["wqkv"].astype(x.dtype)
-            )
-            q_h, k_h, v_h = qkv[0], qkv[1], qkv[2]
+        q_h, k_r, v_r = _project_qkv(cfg, p, h_in)
         if cfg.rope:
             t = q_h.shape[2]
             cos, sin = _rope_tables(
@@ -329,7 +354,8 @@ def transformer_apply(
             cos = cos[None, None, :, :]
             sin = sin[None, None, :, :]
             q_h = _apply_rope(q_h, cos, sin)
-            k_h = _apply_rope(k_h, cos, sin)
+            k_r = _apply_rope(k_r, cos, sin)
+        k_h, v_h = _expand_kv(cfg, k_r, v_r)
         if cfg.sequence_parallel:
             # the ring path works on (B, T, H, K) — the sequence axis is
             # the sharded one; transposes here are per-shard and cheap
@@ -382,14 +408,7 @@ def transformer_apply(
             y, aux = moe(moe_params, h_in)
             x = x + y
         else:
-            h = jax.nn.gelu(
-                jnp.einsum("btd,df->btf", h_in, p["w1"].astype(x.dtype))
-                + p["b1"].astype(x.dtype)
-            )
-            x = x + (
-                jnp.einsum("btf,fd->btd", h, p["w2"].astype(x.dtype))
-                + p["b2"].astype(x.dtype)
-            )
+            x = x + _mlp(p, h_in)
             aux = jnp.zeros((), x.dtype)
         return x, aux
 
@@ -534,10 +553,7 @@ def _decode_builder(cfg: TransformerConfig):
                 moe_params, h_in, k=cfg.moe_k, activation=jax.nn.gelu
             )
         else:
-            h = jax.nn.gelu(
-                h_in @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype)
-            )
-            x = x + h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype)
+            x = x + _mlp(p, h_in)
         return x, ck, cv
 
     def forward_one(params, caches, token, pos):
@@ -569,20 +585,97 @@ def _decode_builder(cfg: TransformerConfig):
         )
 
     def prefill(params, caches, prompt):
-        """Walk the prompt, building caches; returns (caches, last logits)."""
+        """Bulk prefill: ONE causal forward over the whole prompt fills
+        every layer's KV cache and yields the last-position logits —
+        the standard inference split (parallel prefill, serial decode).
+        Round 1 walked the prompt through ``forward_one`` position by
+        position: T_p sequential layer scans; this is a single
+        training-shaped pass (T_p-way parallel on the MXU).
+        """
         b, tp = prompt.shape
-
-        def one(carry, pos):
-            caches, _ = carry
-            logits, caches = forward_one(params, caches, prompt[:, pos], pos)
-            return (caches, logits), None
-
-        (caches, logits), _ = lax.scan(
-            one,
-            (caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)),
-            jnp.arange(tp),
+        if tp == 0:
+            # empty prompt: nothing to prefill — decode starts from
+            # uniform logits, as the round-1 per-position walk did
+            return caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)
+        ck_all, cv_all = caches  # (nl, B, total, H_kv, K)
+        x = (params["embed"][prompt] + params["pos"][:tp]).astype(
+            cfg.compute_dtype
         )
-        return caches, logits
+        if cfg.rope:
+            cos, sin = _rope_tables(
+                jnp.arange(tp), cfg.head_dim, cfg.compute_dtype
+            )  # (Tp, hd/2)
+            cos_b = cos[None, None, :, :]
+            sin_b = sin[None, None, :, :]
+
+        def layer(x, xs):
+            p, ck, cv = xs
+            h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+            q, k_r, v_r = _project_qkv(cfg, p, h_in)
+            if cfg.rope:
+                q = _apply_rope(q, cos_b, sin_b)
+                k_r = _apply_rope(k_r, cos_b, sin_b)
+            # cache holds the UNexpanded kv heads in (B, T, H_kv, K)
+            ck = lax.dynamic_update_slice(
+                ck, k_r.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, 0, 0)
+            )
+            cv = lax.dynamic_update_slice(
+                cv, v_r.transpose(0, 2, 1, 3).astype(cv.dtype), (0, 0, 0, 0)
+            )
+            k_h, v_h = _expand_kv(cfg, k_r, v_r)
+            if cfg.use_flash and (tp <= 128 or tp % 128 == 0):
+                # keep long-prompt prefill O(T) like training — dense
+                # attention would materialize (B, H, Tp, Tp) scores.
+                # Prompts of arbitrary length (not %128) fall back to
+                # dense; training's stricter shape rule doesn't apply
+                # to inference inputs.
+                from deeplearning4j_tpu.ops.pallas_kernels import (
+                    flash_attention_trainable,
+                )
+
+                def pick_block(pref: int) -> int:
+                    if tp <= pref:
+                        return tp
+                    for bs in (pref, 512, 256, 128):
+                        if bs <= pref and tp % bs == 0:
+                            return bs
+                    return 128
+
+                o = flash_attention_trainable(
+                    q, k_h, v_h, causal=True,
+                    block_q=pick_block(512), block_k=pick_block(1024),
+                    layout="bhtd",
+                )
+            else:
+                o = attention(q, k_h, v_h, causal=True, layout="bhtd")
+            x = x + jnp.einsum("bhtk,hkd->btd", o, p["wo"].astype(x.dtype))
+            h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+            if cfg.n_experts:
+                from deeplearning4j_tpu.parallel.expert_parallel import (
+                    moe_reference,
+                )
+
+                moe_params = jax.tree.map(
+                    lambda a: a.astype(x.dtype), p["moe"]
+                )
+                # per-token dense routing, matching block_decode
+                flat = h_in.reshape(-1, h_in.shape[-1])
+                y = moe_reference(
+                    moe_params, flat, k=cfg.moe_k, activation=jax.nn.gelu
+                )
+                x = x + y.reshape(h_in.shape)
+            else:
+                x = x + _mlp(p, h_in)
+            return x, (ck, cv)
+
+        x, (ck_all, cv_all) = lax.scan(
+            layer, x, (params["blocks"], ck_all, cv_all)
+        )
+        x = _layer_norm(
+            x[:, -1], params["lnf_scale"], params["lnf_bias"]
+        )
+        logits = x.astype(jnp.float32) @ params["head"]
+        return (ck_all, cv_all), logits
 
     return forward_one, init_caches, prefill
 

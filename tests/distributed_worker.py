@@ -104,6 +104,26 @@ def main() -> int:
         state, loss = trainer.step(state, xs, ys, jax.random.key(0))
     print(f"WORKERS={','.join(reg.list_workers())}", flush=True)
     print(f"LOSS={float(loss):.10f}", flush=True)
+
+    if len(sys.argv) > 5:
+        # multi-process orbax round-trip: every process writes only the
+        # shards it owns (the npz manager cannot address a multi-process
+        # mesh — the regime AsyncShardedCheckpointManager exists for)
+        from deeplearning4j_tpu.parallel.checkpoint import (
+            AsyncShardedCheckpointManager,
+        )
+
+        mgr = AsyncShardedCheckpointManager(sys.argv[5], save_every=1)
+        mgr.maybe_save(20, state.params, {"loss": float(loss)})
+        mgr.wait()
+        restored, meta = mgr.restore_latest(state.params)
+        ok = all(
+            bool(jnp.all(a == b))
+            for a, b in zip(
+                jax.tree.leaves(restored), jax.tree.leaves(state.params)
+            )
+        ) and int(meta["step"]) == 20
+        print(f"ORBAX={'ok' if ok else 'MISMATCH'}", flush=True)
     return 0
 
 

@@ -71,10 +71,14 @@ def test_two_process_distributed_training_matches_single_process():
         if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
     }
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    import tempfile
+
+    orbax_dir = tempfile.mkdtemp(prefix="dist_orbax_")
     try:
         procs = [
             subprocess.Popen(
-                [sys.executable, str(WORKER), addr, job, str(pid), "2"],
+                [sys.executable, str(WORKER), addr, job, str(pid), "2",
+                 orbax_dir],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, env=env, cwd=str(REPO),
             )
@@ -94,8 +98,14 @@ def test_two_process_distributed_training_matches_single_process():
             assert m and set(m.group(1).split(",")) == {"0", "1"}, (
                 f"bad WORKERS line:\n{out[-3000:]}"
             )
+        # multi-process orbax checkpoint round-tripped on every process
+        for out in outs:
+            assert re.search(r"^ORBAX=ok$", out, re.M), out[-3000:]
     finally:
         server.stop()
+        import shutil
+
+        shutil.rmtree(orbax_dir, ignore_errors=True)
 
     # the replicated loss must agree across processes exactly
     assert losses[0] == losses[1], losses

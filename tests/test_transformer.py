@@ -258,6 +258,19 @@ def test_flash_attention_transformer_matches_dense():
     assert all(np.isfinite(np.asarray(a)).all() for a in jax.tree.leaves(g))
 
 
+def test_generate_from_empty_prompt():
+    """Bulk prefill must keep the round-1 contract: an empty prompt
+    decodes from uniform logits instead of crashing on x[:, -1]."""
+    from deeplearning4j_tpu.models.transformer import transformer_generate
+
+    params = init_transformer(jax.random.key(60), CFG)
+    out = transformer_generate(CFG)(
+        params, jnp.zeros((2, 0), jnp.int32), jax.random.key(0), 4
+    )
+    assert out.shape == (2, 4)
+    assert ((out >= 0) & (out < CFG.vocab_size)).all()
+
+
 def test_flash_block_sizes_divide_any_legal_seq_len():
     """T only has to be a multiple of 128 — the block-size picker must
     not hand the kernel a block that doesn't divide T (T=1536 crashed
