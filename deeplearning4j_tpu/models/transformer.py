@@ -252,6 +252,24 @@ def _apply_rope(x, cos, sin):
     )
 
 
+def _flash_blocks(t: int) -> tuple[int, int]:
+    """(block_q, block_k) for the flash kernel at sequence length t:
+    512/1024 preferred (measured fastest on v5e for T~1024-8192), falling
+    back to the largest candidate that divides t — callers only
+    guarantee t <= 128 or t % 128 == 0. ONE implementation shared by the
+    training block and bulk prefill so kernel selection cannot drift."""
+
+    def pick(pref: int) -> int:
+        if t <= pref:
+            return t
+        for b in (pref, 512, 256, 128):
+            if b <= pref and t % b == 0:
+                return b
+        return 128  # t % 128 == 0 guaranteed by the callers
+
+    return pick(512), pick(1024)
+
+
 def _project_qkv(cfg: TransformerConfig, p, h_in):
     """Shared QKV projection for all sequence-shaped forwards (training
     block and bulk prefill): h_in (B, T, D) -> q (B, H, T, K) and the
@@ -359,12 +377,17 @@ def transformer_apply(
         if cfg.sequence_parallel:
             # the ring path works on (B, T, H, K) — the sequence axis is
             # the sharded one; transposes here are per-shard and cheap
-            # next to the ring collectives
-            o = ring(
-                q_h.transpose(0, 2, 1, 3),
-                k_h.transpose(0, 2, 1, 3),
-                v_h.transpose(0, 2, 1, 3),
-            ).transpose(0, 2, 1, 3)
+            # next to the ring collectives. Named so remat saves the
+            # ring output instead of re-running its collectives in the
+            # backward pass.
+            o = checkpoint_name(
+                ring(
+                    q_h.transpose(0, 2, 1, 3),
+                    k_h.transpose(0, 2, 1, 3),
+                    v_h.transpose(0, 2, 1, 3),
+                ).transpose(0, 2, 1, 3),
+                "attn_out",
+            )
         elif cfg.use_flash:
             from deeplearning4j_tpu.ops.pallas_kernels import (
                 flash_attention_trainable,
@@ -376,28 +399,19 @@ def transformer_apply(
                     f"use_flash needs seq len <= 128 or a multiple of "
                     f"128, got {t}"
                 )
-            # 512/1024 blocks measured fastest for T~1024-8192 on v5e
-            # (small blocks drown in per-instance overhead: 128/128 was
-            # 3x slower at T=1024); fall back to the largest candidate
-            # that divides T — the guard above only promises T % 128
-            # == 0, so e.g. T=1536 must get 512/512, not 512/1024
-
-            def pick_block(pref: int) -> int:
-                if t <= pref:
-                    return t
-                for b in (pref, 512, 256, 128):
-                    if b <= pref and t % b == 0:
-                        return b
-                return 128  # t % 128 == 0 guaranteed above
-
+            # no attn_out naming here: the kernel's own flash_out
+            # residual is the saveable (naming both would store the
+            # same tensor twice and cost ~450MB at GPT-2-small scale)
+            bq, bk = _flash_blocks(t)
             o = flash_attention_trainable(
                 q_h, k_h, v_h, causal=True,
-                block_q=pick_block(512), block_k=pick_block(1024),
-                layout="bhtd",
+                block_q=bq, block_k=bk, layout="bhtd",
             )
         else:
-            o = attention(q_h, k_h, v_h, causal=True, layout="bhtd")
-        o = checkpoint_name(o, "attn_out")
+            o = checkpoint_name(
+                attention(q_h, k_h, v_h, causal=True, layout="bhtd"),
+                "attn_out",
+            )
         x = x + jnp.einsum("bhtk,hkd->btd", o, p["wo"].astype(x.dtype))
         # ffn sublayer: dense MLP or routed MoE
         h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
@@ -414,17 +428,17 @@ def transformer_apply(
 
     if cfg.remat:
         if cfg.remat_policy == "dots_no_batch":
-            # also save the attention output by name: it is a pallas
-            # custom call under use_flash (not a dot), and without the
-            # name the policy would re-run the whole flash forward
-            # inside the backward pass
+            # also save the flash-attention custom-call outputs by name
+            # (attn_out plus the kernel's internal out/lse residuals —
+            # they are not dots, and without the names the policy
+            # re-runs the whole pallas forward inside the backward pass)
             body = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.save_from_both_policies(
                     jax.checkpoint_policies
                     .dots_with_no_batch_dims_saveable,
                     jax.checkpoint_policies.save_only_these_names(
-                        "attn_out"
+                        "attn_out", "flash_out", "flash_lse"
                     ),
                 ),
             )
@@ -633,18 +647,10 @@ def _decode_builder(cfg: TransformerConfig):
                     flash_attention_trainable,
                 )
 
-                def pick_block(pref: int) -> int:
-                    if tp <= pref:
-                        return tp
-                    for bs in (pref, 512, 256, 128):
-                        if bs <= pref and tp % bs == 0:
-                            return bs
-                    return 128
-
+                bq, bk = _flash_blocks(tp)
                 o = flash_attention_trainable(
                     q, k_h, v_h, causal=True,
-                    block_q=pick_block(512), block_k=pick_block(1024),
-                    layout="bhtd",
+                    block_q=bq, block_k=bk, layout="bhtd",
                 )
             else:
                 o = attention(q, k_h, v_h, causal=True, layout="bhtd")

@@ -133,3 +133,41 @@ class PosTagger:
 
             tokenizer = DefaultTokenizer()
         return self.tag(tokenizer.tokens(sentence))
+
+
+# PTB -> coarse universal tag mapping for training from the bundled
+# treebank (nlp/parser.py), which carries PTB preterminals
+_PTB_TO_UNIVERSAL = {
+    "DT": "DET", "NN": "NOUN", "NNS": "NOUN", "NNP": "NOUN",
+    "VBD": "VERB", "VBZ": "VERB", "VB": "VERB", "VBG": "VERB",
+    "IN": "ADP", "JJ": "ADJ", "PRP": "PRON", "RB": "ADV", "CC": "CONJ",
+    "TO": "PRT", "CD": "NUM",
+}
+
+
+def tagged_sentences_from_treebank() -> list[list[tuple[str, str]]]:
+    """(word, universal-tag) sequences extracted from the bundled
+    mini-treebank — the training corpus the default tagger ships with
+    (the reference ships a pretrained OpenNLP binary instead)."""
+    from deeplearning4j_tpu.nlp.parser import bundled_treebank
+
+    out = []
+    for tree in bundled_treebank():
+        sent = []
+        for leaf in tree.leaves():
+            if leaf.word is None:
+                continue
+            tag = _PTB_TO_UNIVERSAL.get(leaf.label, "NOUN")
+            sent.append((leaf.word, tag))
+        if sent:
+            out.append(sent)
+    return out
+
+
+def default_tagger() -> PosTagger:
+    """A PosTagger pre-trained on the bundled treebank (built fresh each
+    call; training is a few ms). OOV words still flow through the
+    suffix/lexicon backoff inside the HMM emissions."""
+    tagger = PosTagger()
+    tagger.fit(tagged_sentences_from_treebank())
+    return tagger

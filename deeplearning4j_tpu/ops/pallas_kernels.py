@@ -295,6 +295,15 @@ def _flash_bhtd(qf, kf, vf, block_q, block_k, interpret, causal):
 
 def _flash_fwd_rule(qf, kf, vf, block_q, block_k, interpret, causal):
     out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal)
+    # name the residuals so a surrounding jax.checkpoint policy can mark
+    # them saveable: without this, rematerialization re-runs the whole
+    # pallas forward inside the backward pass just to regenerate lse
+    # (q/k/v are dot outputs the dots policy already saves) — measured
+    # 16.5ms/step at GPT-2-small scale
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (qf, kf, vf, out, lse)
 
 

@@ -27,3 +27,8 @@ from deeplearning4j_tpu.parallel.pipeline_parallel import (  # noqa: F401
     split_microbatches,
     stack_stage_params,
 )
+from deeplearning4j_tpu.parallel.registry import (  # noqa: F401
+    NetworkRegistry,
+    RegistryLock,
+    RegistryServer,
+)

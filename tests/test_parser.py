@@ -81,3 +81,17 @@ def test_rntn_trains_on_pcfg_parsed_raw_text():
     model = RNTN(num_classes=2, dim=6, seed=0)
     losses = model.fit_trees(trees, epochs=2)
     assert np.isfinite(losses).all()
+
+
+def test_default_pos_tagger_trained_on_treebank():
+    from deeplearning4j_tpu.nlp.pos import default_tagger
+
+    tagger = default_tagger()
+    assert tagger.trained
+    tags = dict(tagger.tag("the cat saw a dog".split()))
+    assert tags["the"] == "DET" and tags["a"] == "DET"
+    assert tags["cat"] == "NOUN" and tags["dog"] == "NOUN"
+    assert tags["saw"] == "VERB"
+    # OOV word goes through the rule backoff inside the HMM
+    oov = dict(tagger.tag("the wug jumped".split()))
+    assert oov["jumped"] == "VERB"
