@@ -94,6 +94,9 @@ def _train_transformer(args) -> int:
         d_ff=4 * args.d_model,
         max_len=args.seq_len + 1,
         n_experts=args.n_experts,
+        use_flash=args.flash,
+        remat=args.remat,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
     step, init_state, shard_tokens = transformer_train_step(
         mesh, cfg,
@@ -315,6 +318,21 @@ def main(argv: list[str] | None = None) -> int:
     t.add_argument("--n-experts", type=int, default=0)
     t.add_argument("--tp", type=int, default=1, help="tensor-parallel ways")
     t.add_argument("--fsdp", action="store_true")
+    t.add_argument(
+        "--flash", action="store_true",
+        help="pallas flash attention (seq-len <= 128 or a multiple of "
+        "128); the TPU perf recipe — see PERF.md",
+    )
+    t.add_argument(
+        "--remat", action="store_true",
+        help="selective rematerialization (dots_no_batch policy): "
+        "recompute elementwise ops in backward instead of storing the "
+        "(B,H,T,T) attention probs — required for long-context training",
+    )
+    t.add_argument(
+        "--bf16", action="store_true",
+        help="bfloat16 compute (f32 params/softmax) — MXU-native",
+    )
     _add_distributed_flags(t)
     t.set_defaults(fn=cmd_train)
 

@@ -88,6 +88,30 @@ class TransformerConfig:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
 
+    # JSON round-trip, matching the framework's config story (nn/conf.py
+    # ≙ NeuralNetConfiguration.toJson): dtypes serialize by name
+    def to_json(self) -> str:
+        import json
+
+        d = dataclasses.asdict(self)
+        d["compute_dtype"] = jnp.dtype(self.compute_dtype).name
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "TransformerConfig":
+        import json
+
+        d = json.loads(s)
+        # tolerant like nn/conf.py's from_dict: ignore unknown keys
+        # (forward compatibility) and fall back to defaults for missing
+        # ones — the checkpoint-config round-trip must survive version
+        # skew in either direction
+        known = {f.name for f in dataclasses.fields(cls)}
+        d = {k: v for k, v in d.items() if k in known}
+        if "compute_dtype" in d:
+            d["compute_dtype"] = jnp.dtype(d["compute_dtype"])
+        return cls(**d)
+
     def __post_init__(self):
         if self.n_heads % self.kv_heads:
             raise ValueError(

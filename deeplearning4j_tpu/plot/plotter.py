@@ -186,7 +186,9 @@ def serve_tsne(words: list[str], coords: np.ndarray, port: int = 0) -> int:
         def do_GET(self):  # noqa: N802
             if self.path in ("/", "/index.html"):
                 send_body(self, 200, page, "text/html; charset=utf-8")
-            elif self.path == "/coords":
+            elif self.path in ("/coords", "/api/coords"):
+                # /api/coords matches the reference's dropwizard
+                # ApiResource path; /coords is what the bundled page uses
                 send_body(self, 200, payload, "application/json")
             else:
                 # unknown paths (favicon.ico, typos) must not ship the

@@ -42,16 +42,18 @@ class LocalModelSaver:
 
 
 class S3ModelSaver:
-    """≙ S3ModelSaver (deeplearning4j-aws). Requires boto3."""
+    """≙ S3ModelSaver (deeplearning4j-aws). Requires boto3 — or an
+    injected ``client`` implementing put_object/get_object (boto3's S3
+    surface), which also makes the saver logic exercisable offline."""
 
-    def __init__(self, bucket: str, prefix: str = ""):
-        try:
-            import boto3  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError("S3ModelSaver requires boto3") from e
-        import boto3
-
-        self.client = boto3.client("s3")
+    def __init__(self, bucket: str, prefix: str = "", client=None):
+        if client is None:
+            try:
+                import boto3
+            except ImportError as e:
+                raise RuntimeError("S3ModelSaver requires boto3") from e
+            client = boto3.client("s3")
+        self.client = client
         self.bucket = bucket
         self.prefix = prefix.rstrip("/")
 
@@ -72,14 +74,16 @@ class GCSModelSaver:
     """GCS twin of S3ModelSaver (the TPU-native object store). Requires
     google-cloud-storage."""
 
-    def __init__(self, bucket: str, prefix: str = ""):
-        try:
-            from google.cloud import storage  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError("GCSModelSaver requires google-cloud-storage") from e
-        from google.cloud import storage
-
-        self.bucket = storage.Client().bucket(bucket)
+    def __init__(self, bucket: str, prefix: str = "", bucket_client=None):
+        if bucket_client is None:
+            try:
+                from google.cloud import storage
+            except ImportError as e:
+                raise RuntimeError(
+                    "GCSModelSaver requires google-cloud-storage"
+                ) from e
+            bucket_client = storage.Client().bucket(bucket)
+        self.bucket = bucket_client
         self.prefix = prefix.rstrip("/")
 
     def _key(self, name: str) -> str:

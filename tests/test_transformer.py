@@ -494,3 +494,17 @@ def test_lm_optimizer_trains_with_warmup_and_clipping(devices):
         losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_config_json_roundtrip():
+    """TransformerConfig serializes like the rest of the framework's
+    configs (nn/conf.py ≙ NeuralNetConfiguration.toJson) — dtypes by
+    name, every field preserved."""
+    cfg = TransformerConfig(
+        d_model=64, n_heads=4, n_kv_heads=2, use_flash=True, rope=True,
+        compute_dtype=jnp.bfloat16, n_experts=0, remat=True,
+        scan_layers=False,
+    )
+    again = TransformerConfig.from_json(cfg.to_json())
+    assert again == cfg
+    assert again.compute_dtype == jnp.bfloat16
