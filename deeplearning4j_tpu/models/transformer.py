@@ -946,15 +946,17 @@ def transformer_train_step(
     )
 
     def init_state(key):
+        # place_global handles the multi-process case (device_put cannot
+        # address remote shards)
         params = jax.tree.map(
-            jax.device_put, init_transformer(key, cfg), shardings
+            mesh_lib.place_global, init_transformer(key, cfg), shardings
         )
         # adamw state mirrors the param tree, so it inherits the TP shardings
         opt_state = optimizer.init(params)
         return params, opt_state
 
     def shard_tokens(tokens):
-        return jax.device_put(tokens, batch_sh)
+        return mesh_lib.place_global(tokens, batch_sh)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens):

@@ -109,21 +109,11 @@ class DataParallelTrainer:
             step=jnp.zeros((), jnp.int32),
         )
         repl = NamedSharding(self.mesh, P())
-        if jax.process_count() == 1:
-            return jax.device_put(state, repl)
-        # multi-process: device_put cannot address remote shards; build
-        # each (replicated) leaf from the process-local value instead.
-        # Every process computed identical params (same seed), which is
-        # exactly the replication invariant.
-        import numpy as np
-
-        def mk(a):
-            a = np.asarray(a)
-            return jax.make_array_from_callback(
-                a.shape, repl, lambda idx: a[idx]
-            )
-
-        return jax.tree.map(mk, state)
+        # place_global handles multi-process placement (every process
+        # computed identical params — exactly the replication invariant)
+        return jax.tree.map(
+            lambda a: mesh_lib.place_global(a, repl), state
+        )
 
     def shard_batch(self, x, y):
         shard = NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS))
@@ -138,17 +128,11 @@ class DataParallelTrainer:
         ``device_put`` of a host array onto a global sharding would try
         to address other processes' devices.
         """
-        import numpy as np
-
         shard = NamedSharding(self.mesh, P(mesh_lib.DATA_AXIS))
-
-        def mk(a):
-            a = np.asarray(a)
-            return jax.make_array_from_callback(
-                a.shape, shard, lambda idx: a[idx]
-            )
-
-        return mk(x), mk(y)
+        return (
+            mesh_lib.place_global(x, shard),
+            mesh_lib.place_global(y, shard),
+        )
 
     def step(self, state: TrainState, x, y, key) -> tuple[TrainState, jax.Array]:
         return self._step(state, x, y, key)

@@ -115,3 +115,20 @@ def shard_batch(mesh: Mesh, batch):
     return jax.tree.map(
         lambda x: jax.device_put(x, batch_sharding(mesh)), batch
     )
+
+
+def place_global(value, sharding):
+    """Place a host value onto a (possibly multi-process) sharding.
+
+    Single-process: plain ``jax.device_put``. Multi-process:
+    ``device_put`` cannot address remote shards, so the global array is
+    built from the (identical-on-every-process) host value via
+    ``jax.make_array_from_callback`` — each process materializes only
+    the shards its local devices own. The one placement implementation
+    shared by DataParallelTrainer, transformer_train_step, and any
+    future sharded entry point.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    a = np.asarray(value)
+    return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])

@@ -124,6 +124,43 @@ def main() -> int:
             )
         ) and int(meta["step"]) == 20
         print(f"ORBAX={'ok' if ok else 'MISMATCH'}", flush=True)
+
+    # cross-process tensor parallelism: build a (dp=4, tp=2) mesh whose
+    # TP pairs SPAN the process boundary (device i paired with i+4, i.e.
+    # one device from each process), so the Megatron layout's psum runs
+    # over the host-to-host transport — the regime a real multi-host TPU
+    # pod exercises over DCN. ≙ the reference's cross-JVM parameter
+    # traffic, now an in-graph collective.
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, transformer_train_step,
+    )
+    from deeplearning4j_tpu.parallel import mesh as mesh_lib
+
+    if nprocs != 2:
+        # the cross-process pairing below is written for exactly 2
+        # processes; other topologies skip the TP check cleanly
+        return 0
+    devs = jax.devices()
+    local = jax.local_device_count()
+    grid = np.array(
+        [[devs[i], devs[i + local]] for i in range(local)], dtype=object
+    )
+    tmesh = Mesh(grid, (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))
+    tcfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_len=16,
+    )
+    tstep, tinit, tshard = transformer_train_step(tmesh, tcfg)
+    tparams, topt = tinit(jax.random.key(5))
+    ttoks = tshard(
+        np.random.default_rng(5).integers(0, 32, (8, 9)).astype(np.int32)
+    )
+    tl = None
+    for _ in range(3):
+        tparams, topt, tl = tstep(tparams, topt, ttoks)
+    print(f"TPLOSS={float(tl):.10f}", flush=True)
     return 0
 
 

@@ -101,6 +101,15 @@ def test_two_process_distributed_training_matches_single_process():
         # multi-process orbax checkpoint round-tripped on every process
         for out in outs:
             assert re.search(r"^ORBAX=ok$", out, re.M), out[-3000:]
+        # cross-process tensor parallelism (TP pairs spanning the process
+        # boundary): replicated loss agrees across processes and with
+        # the single-process run of the same (4, 2) program
+        tp_losses = []
+        for out in outs:
+            m = re.search(r"^TPLOSS=([0-9.eE+-]+)$", out, re.M)
+            assert m, f"no TPLOSS line:\n{out[-3000:]}"
+            tp_losses.append(float(m.group(1)))
+        assert tp_losses[0] == tp_losses[1], tp_losses
     finally:
         server.stop()
         import shutil
@@ -114,3 +123,33 @@ def test_two_process_distributed_training_matches_single_process():
     # tolerance, not bit-equality)
     ref = _reference_loss()
     np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
+    # the cross-process-TP transformer run matches the same program on a
+    # single-process (4, 2) mesh
+    np.testing.assert_allclose(
+        tp_losses[0], _reference_tp_loss(), rtol=1e-5, atol=1e-6
+    )
+
+
+def _reference_tp_loss():
+    import jax
+    import numpy as np_
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, transformer_train_step,
+    )
+    from deeplearning4j_tpu.parallel import mesh as mesh_lib
+
+    tcfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_len=16,
+    )
+    tmesh = mesh_lib.dp_mp_mesh(4, 2)
+    tstep, tinit, tshard = transformer_train_step(tmesh, tcfg)
+    tparams, topt = tinit(jax.random.key(5))
+    ttoks = tshard(
+        np_.random.default_rng(5).integers(0, 32, (8, 9)).astype(np_.int32)
+    )
+    tl = None
+    for _ in range(3):
+        tparams, topt, tl = tstep(tparams, topt, ttoks)
+    return float(tl)
