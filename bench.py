@@ -259,6 +259,63 @@ def _bench_transformer(args, preset_name: str):
     return tokens_per_sec, f"{p['metric']}_train_tokens_per_sec_per_chip", mfu
 
 
+def _bench_decode(args):
+    """KV-cached autoregressive decode throughput on the GPT-2-small
+    config: bulk prefill (512 tokens) + 64 sampled steps per call, all
+    inside one jitted program. Reported rate counts only the NEW tokens
+    (prefill attributed as overhead — the conservative convention), so
+    the number is directly the serving-side tokens/sec/chip."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+        transformer_generate,
+    )
+
+    p = _TRANSFORMER_PRESETS["transformer"]
+    batch, prompt_len, new = 16, 512, 64
+    flash = p["flash"] if args.flash is None else args.flash
+    cfg = TransformerConfig(
+        vocab_size=p["vocab"], d_model=p["d_model"], n_heads=p["n_heads"],
+        n_layers=p["n_layers"], d_ff=p["d_ff"],
+        max_len=prompt_len + new + 1,
+        # flash is honored by the bulk-prefill path (the 512-token
+        # prompt satisfies the kernel's %128 constraint); the per-token
+        # decode steps use the KV-cache path either way
+        use_flash=flash,
+        compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    gen = jax.jit(
+        functools.partial(
+            transformer_generate(cfg), max_new=new, temperature=1.0,
+            top_k=40,
+        )
+    )
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, p["vocab"], (batch, prompt_len)).astype(np.int32)
+    )
+    holder = {"out": None}
+
+    def run(i):
+        holder["out"] = gen(params, prompt, jax.random.key(i))
+
+    def drain():
+        out = np.asarray(holder["out"][:, -1])
+        assert ((out >= 0) & (out < p["vocab"])).all()
+
+    reps, dt = _run_window(args, run, drain, min_reps=5)
+    return (
+        batch * new * reps / dt,
+        "transformer_gpt2s_decode_tokens_per_sec_per_chip",
+    )
+
+
 def _build(model: str, batch: int):
     """(params, loss_fn, x, y, metric_name) for the chosen workload."""
     import jax.numpy as jnp
@@ -290,7 +347,8 @@ def _build(model: str, batch: int):
 
 
 _ALL_WORKLOADS = (
-    "lenet", "alexnet", "word2vec", "transformer", "transformer-flash-8k"
+    "lenet", "alexnet", "word2vec", "transformer", "transformer-flash-8k",
+    "transformer-decode",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -299,6 +357,7 @@ _ALL_WORKLOADS = (
 _AUTO_DTYPE = {
     "lenet": "f32", "alexnet": "bf16", "word2vec": "f32",
     "transformer": "bf16", "transformer-flash-8k": "bf16",
+    "transformer-decode": "bf16",
 }
 
 
@@ -385,6 +444,13 @@ def _run_one_inner(args, jax) -> None:
             raise SystemExit("--scaling applies to the trainer workloads, "
                              "not the single-device word2vec kernel")
         per_chip, metric = _bench_word2vec(args)
+        _report(args, per_chip, metric, jax)
+        return
+
+    if args.model == "transformer-decode":
+        if args.scaling:
+            raise SystemExit("--scaling does not apply to decode")
+        per_chip, metric = _bench_decode(args)
         _report(args, per_chip, metric, jax)
         return
 
@@ -496,7 +562,10 @@ def _report(args, per_chip: float, metric: str, jax, mfu=None) -> None:
         key = f"{args.model}_pairs_per_sec_per_chip"
     else:
         key = f"{args.model}_samples_per_sec_per_chip"
-    is_transformer = args.model in _TRANSFORMER_PRESETS
+    is_transformer = (
+        args.model in _TRANSFORMER_PRESETS
+        or args.model == "transformer-decode"
+    )
     comparable = is_transformer or args.batch == BATCH
     baseline = records.get(platform, {}).get(key) if comparable else None
     record_ok = args.dtype == "bf16" if is_transformer else args.dtype == "f32"

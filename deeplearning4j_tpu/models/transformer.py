@@ -247,7 +247,7 @@ def transformer_shardings(mesh: Mesh, cfg: TransformerConfig | None = None):
 
 def place_transformer_params(mesh: Mesh, params, cfg=None):
     return jax.tree.map(
-        jax.device_put, params, transformer_shardings(mesh, cfg)
+        mesh_lib.place_global, params, transformer_shardings(mesh, cfg)
     )
 
 

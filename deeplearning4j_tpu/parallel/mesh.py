@@ -111,9 +111,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch):
-    """Device-put a host batch with its leading axis split over the mesh."""
+    """Place a host batch with its leading axis split over the mesh
+    (multi-process safe via :func:`place_global`)."""
     return jax.tree.map(
-        lambda x: jax.device_put(x, batch_sharding(mesh)), batch
+        lambda x: place_global(x, batch_sharding(mesh)), batch
     )
 
 
