@@ -11,6 +11,8 @@ per workload — the driver's round record captures all of them:
                   an analytic-FLOPs ``mfu`` field
 - ``transformer-flash-8k`` long-context flash workload (T=8192) so
                   regressions in the pallas kernel path are visible
+- ``transformer-decode`` KV-cached sampling (bulk prefill + 64 decode
+                  steps) — serving-convention tokens/sec/chip
 
 ``--model X`` runs a single workload. ``--scaling`` reports 1->N-chip
 data-parallel efficiency (lenet/alexnet); ``--profile DIR`` captures an

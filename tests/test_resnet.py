@@ -1,0 +1,68 @@
+"""ResNet + BatchNorm (beyond-parity modern CNN family)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.resnet import (
+    ResNetConfig, init_resnet, resnet_apply, resnet_train_step,
+)
+
+CFG = ResNetConfig(num_classes=4, blocks_per_stage=1,
+                   stage_channels=(8, 16))
+
+
+def _data(n=16, hw=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, n)
+    # make the task learnable: shift each image by its class
+    x += labels[:, None, None, None] * 0.7
+    y = np.eye(4, dtype=np.float32)[labels]
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_forward_shapes_and_state_update():
+    params, state = init_resnet(jax.random.key(0), CFG)
+    x, _ = _data()
+    logits, new_state = resnet_apply(CFG, train=True)(params, state, x)
+    assert logits.shape == (16, 4)
+    # train mode rolls the running statistics
+    assert not np.allclose(
+        np.asarray(new_state["stem"]["mean"]),
+        np.asarray(state["stem"]["mean"]),
+    )
+    # eval mode leaves them untouched and is deterministic
+    l1, s1 = resnet_apply(CFG, train=False)(params, state, x)
+    l2, s2 = resnet_apply(CFG, train=False)(params, state, x)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    np.testing.assert_array_equal(
+        np.asarray(s1["stem"]["mean"]), np.asarray(state["stem"]["mean"])
+    )
+
+
+def test_trains_and_eval_mode_classifies():
+    step, init = resnet_train_step(CFG)
+    params, state, opt_state = init(jax.random.key(1))
+    x, y = _data(n=32, seed=1)
+    losses = []
+    for _ in range(40):
+        params, state, opt_state, loss = step(params, state, opt_state, x, y)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+    # eval-mode accuracy on the training batch after fitting
+    logits, _ = resnet_apply(CFG, train=False)(params, state, x)
+    acc = float(
+        (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).mean()
+    )
+    assert acc >= 0.75, acc
+
+
+def test_projection_skips_only_on_channel_change():
+    params, _ = init_resnet(jax.random.key(2), CFG)
+    # first block of stage 0: in==out channels (stem matches stage 0)
+    assert "proj" not in params["stages"][0][0]
+    # first block of stage 1: 8 -> 16 channels needs the 1x1 projection
+    assert "proj" in params["stages"][1][0]
+    assert params["stages"][1][0]["proj"].shape == (1, 1, 8, 16)
