@@ -98,9 +98,11 @@ def init_resnet(key, cfg: ResNetConfig):
     return params, state
 
 
-def _conv(x, w, stride=1):
+def _conv(x, w):
+    # all convs are stride-1 SAME by design: downsampling happens only
+    # through the count-corrected average pool at stage transitions
     return lax.conv_general_dilated(
-        x, w.astype(x.dtype), (stride, stride), "SAME",
+        x, w.astype(x.dtype), (1, 1), "SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
 
@@ -129,21 +131,21 @@ def resnet_apply(cfg: ResNetConfig, train: bool):
 
     def block_fn(x, bp, bs):
         h, bs1 = _batch_norm(
-            _conv(x, bp["conv1"], 1), bp["bn1"], bs["bn1"], train,
+            _conv(x, bp["conv1"]), bp["bn1"], bs["bn1"], train,
             cfg.bn_momentum, cfg.bn_eps,
         )
         h = jax.nn.relu(h)
         h, bs2 = _batch_norm(
-            _conv(h, bp["conv2"], 1), bp["bn2"], bs["bn2"], train,
+            _conv(h, bp["conv2"]), bp["bn2"], bs["bn2"], train,
             cfg.bn_momentum, cfg.bn_eps,
         )
-        skip = _conv(x, bp["proj"], 1) if "proj" in bp else x
+        skip = _conv(x, bp["proj"]) if "proj" in bp else x
         return jax.nn.relu(h + skip), {"bn1": bs1, "bn2": bs2}
 
     def apply(params, state, x):
         policy = dtypes.get_policy()
         x = x.astype(policy.compute_dtype)
-        h = _conv(x, params["stem"]["w"], 1)
+        h = _conv(x, params["stem"]["w"])
         h, stem_s = _batch_norm(
             h, params["stem"]["bn"], state["stem"], train,
             cfg.bn_momentum, cfg.bn_eps,
