@@ -412,6 +412,185 @@ def flash_attention_trainable(
     return out if layout == "bhtd" else out.transpose(0, 2, 1, 3)
 
 
+# -- flash decode attention (single-position KV-cache read) -------------------
+#
+# The decode hot loop reads the WHOLE KV cache every step, so its HBM
+# layout is the perf story. A (B, T, H, K) cache tiles on (H, K) =
+# (12, 64) which Mosaic/XLA pads to (16, 128) — 2.67x the logical bytes
+# streamed per step (measured: the QK einsum alone was 601us/step at
+# GPT-2-small B=16). This kernel reads a PACKED (B, T, H*K) cache whose
+# minor dim is a lane-aligned 768: padding ~1.01x, and the per-head
+# split happens in registers via an iota-built block-diagonal expansion
+# matrix (no lane-splitting relayout). The online softmax runs in VMEM
+# scratch across sequential T blocks, exactly like the training flash
+# kernel; masked positions (> pos, or cache padding) contribute nothing
+# and fully-invisible blocks skip compute.
+
+
+def _flash_decode_kernel(
+    q_ref, k_ref, v_ref, pos_ref, o_ref, m_s, l_s, acc_s,
+    *, block_t: int, n_t: int, n_kv_heads: int, head_dim: int,
+    groups: int, scale: float,
+):
+    tt = pl.program_id(1)
+    t_start = tt * block_t
+    pos = pos_ref[0, 0]
+
+    @pl.when(tt == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, -jnp.inf)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    hk = n_kv_heads * head_dim
+    # block-diagonal reducer E[j, h] = (j // head_dim == h): one MXU dot
+    # with E sums each head's lane segment; E.T broadcasts per-head
+    # scalars back across the segment. Built from iota — no data.
+    j_head = jax.lax.broadcasted_iota(
+        jnp.int32, (hk, n_kv_heads), 0
+    ) // head_dim
+    h_col = jax.lax.broadcasted_iota(jnp.int32, (hk, n_kv_heads), 1)
+    e_mat = (j_head == h_col).astype(jnp.float32)  # (hk, n_kv_heads)
+
+    @pl.when(t_start <= pos)
+    def _compute():
+        # operands stay in the storage dtype (bf16 on TPU: the MXU fast
+        # path — f32-operand dots measured ~4x slower and dominated the
+        # kernel); only the softmax state and accumulators are f32
+        kb = k_ref[0, 0, 0]  # (block_t, hk)
+        vb = v_ref[0, 0, 0]
+        e_low = e_mat.astype(kb.dtype)
+        rows = t_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_t, 1), 0
+        )
+        invalid = rows > pos  # (block_t, 1)
+        for g in range(groups):
+            qg = q_ref[0, g:g + 1, :].astype(kb.dtype)  # (1, hk)
+            # s[t, h] = <q_h, k_th> : elementwise then head-segment sum
+            s = jnp.dot(
+                kb * qg, e_low, preferred_element_type=jnp.float32
+            ) * scale  # (block_t, n_kv_heads)
+            s = jnp.where(invalid, -jnp.inf, s)
+            m_prev = m_s[g:g + 1, :]  # (1, n_kv_heads)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+            p = jnp.exp(s - m_new)  # (block_t, h) f32
+            corr = jnp.exp(m_prev - m_new)  # (1, h)
+            l_s[g:g + 1, :] = corr * l_s[g:g + 1, :] + jnp.sum(
+                p, axis=0, keepdims=True
+            )
+            # expand per-head weights across the head's lane segment
+            # (o[j] = sum_t p[t, head(j)] * v[t, j]), then reduce over t
+            # with a ones-vector dot — an MXU reduction instead of a
+            # VPU convert+reduce chain
+            p_exp = jnp.dot(
+                p.astype(kb.dtype), e_low.T,
+                preferred_element_type=jnp.float32,
+            ).astype(kb.dtype)  # (block_t, hk)
+            pv = jnp.dot(
+                jnp.ones((1, block_t), kb.dtype), p_exp * vb,
+                preferred_element_type=jnp.float32,
+            )  # (1, hk)
+            corr_exp = jnp.dot(
+                corr.astype(e_mat.dtype), e_mat.T,
+                preferred_element_type=jnp.float32,
+            )
+            acc_s[g:g + 1, :] = acc_s[g:g + 1, :] * corr_exp + pv
+            m_s[g:g + 1, :] = m_new
+
+    @pl.when(tt == n_t - 1)
+    def _finalize():
+        l_exp = jnp.dot(
+            jnp.maximum(l_s[:], 1e-30), e_mat.T,
+            preferred_element_type=jnp.float32,
+        )  # (groups, hk)
+        o_ref[0] = (acc_s[:] / l_exp).astype(o_ref.dtype)
+
+
+def flash_decode_attention(
+    q: jax.Array,
+    kvcache: jax.Array,
+    pos: jax.Array,
+    n_kv_heads: int,
+    layer: int = 0,
+    block_t: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """One decode step of causal attention against a packed KV cache.
+
+    ``q``: (B, G, Hkv*K) — query heads grouped for GQA (G = H/Hkv; 1 for
+    MHA), each group packed head-major. ``kvcache``: the FULL STACKED
+    (n_layers, 2, B, T, Hkv*K) cache (axis 1: K then V) — ``layer`` (a
+    static int) selects the layer inside the BlockSpec index map, so no
+    host-side slice is needed. (Slicing the stack outside the kernel
+    materializes a copy of the whole layer cache per call — a custom
+    call needs a dense operand buffer, so XLA cannot fuse the slice the
+    way it fuses one feeding an einsum: 521us/step at GPT-2-small,
+    measured.) T must be a multiple of ``block_t`` (callers pad; rows
+    beyond ``pos`` are masked so padding is free). ``pos``: scalar
+    int32, the position being decoded — rows > pos are invisible.
+    Returns (B, G, Hkv*K) attention output in q's dtype.
+    """
+    b, g, hk = q.shape
+    t = kvcache.shape[3]
+    head_dim = hk // n_kv_heads
+    if block_t is None:
+        # one block up to T=1024 (fewer grid cells measurably beats
+        # smaller streamed blocks here — per-cell overhead dominates at
+        # this arithmetic intensity), splitting only when VMEM demands:
+        # smallest divisor count that keeps blocks <= 1024 and 8-aligned.
+        # Callers size T as a multiple of 512 above 1024 (init_caches),
+        # which guarantees this search lands on blocks in [512, 1024];
+        # an adversarial T (8*prime) would otherwise walk down to 8-row
+        # blocks and pay ~100x the per-cell fixed cost.
+        n_t = -(-t // 1024)
+        while t % n_t or (t // n_t) % 8:
+            n_t += 1
+        block_t = t // n_t
+    block_t = min(block_t, t)
+    assert t % block_t == 0, (t, block_t)
+    interpret = (not _on_tpu()) if interpret is None else interpret
+    n_t = t // block_t
+    kernel = functools.partial(
+        _flash_decode_kernel, block_t=block_t, n_t=n_t,
+        n_kv_heads=n_kv_heads, head_dim=head_dim, groups=g,
+        scale=1.0 / (head_dim**0.5),
+    )
+    pos_arr = jnp.reshape(pos, (1, 1)).astype(jnp.int32)
+    if pltpu is not None and not interpret:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    else:
+        params = None
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, g, hk), q.dtype),
+        grid=(b, n_t),
+        in_specs=[
+            pl.BlockSpec((1, g, hk), lambda i, tt: (i, 0, 0)),
+            # the K and V planes of the one stacked cache buffer, as two
+            # block views (XLA dedups the duplicated operand)
+            pl.BlockSpec(
+                (1, 1, 1, block_t, hk),
+                lambda i, tt: (layer, 0, i, tt, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, block_t, hk),
+                lambda i, tt: (layer, 1, i, tt, 0),
+            ),
+            pl.BlockSpec((1, 1), lambda i, tt: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, g, hk), lambda i, tt: (i, 0, 0)),
+        scratch_shapes=[
+            _vmem((g, n_kv_heads), jnp.float32),
+            _vmem((g, n_kv_heads), jnp.float32),
+            _vmem((g, hk), jnp.float32),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(q, kvcache, kvcache, pos_arr)
+
+
 # -- fused embedding dot (word2vec HS read side) ------------------------------
 
 def _emb_dot_kernel(h_ref, w_ref, mask_ref, out_ref):
