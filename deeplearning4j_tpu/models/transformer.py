@@ -663,10 +663,15 @@ def _decode_builder(cfg: TransformerConfig):
             p_i = jax.tree.map(lambda a: a[i], params["blocks"])
             x, kv_all = block_decode(x, p_i, kv_all, i, pos)
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-        # head matmul in the compute dtype (bf16: half the weight stream
-        # and the MXU fast path — decode is weight-streaming-bound), then
-        # upcast so sampling/softmax math stays f32
-        logits = (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+        # head matmul with bf16 OPERANDS (half the weight stream and the
+        # MXU fast path — decode is weight-streaming-bound) but f32
+        # ACCUMULATION: a bf16-output dot would quantize the logits to
+        # 8 mantissa bits, creating arbitrary ties at the top-k
+        # threshold and in beam scores over V=50k
+        logits = jnp.einsum(
+            "bd,dv->bv", x, params["head"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
         return logits, kv_all
 
     def cast_params(params):
@@ -788,7 +793,10 @@ def _decode_builder(cfg: TransformerConfig):
         x = _layer_norm(
             x[:, -1], params["lnf_scale"], params["lnf_bias"]
         )
-        logits = (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+        logits = jnp.einsum(
+            "bd,dv->bv", x, params["head"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )  # bf16 operands, f32 accumulation — see forward_one
         return kv_all, logits
 
     return forward_one, init_caches, prefill, cast_params

@@ -533,6 +533,8 @@ def flash_decode_attention(
     b, g, hk = q.shape
     t = kvcache.shape[3]
     head_dim = hk // n_kv_heads
+    # the block search below requires an 8-aligned T to terminate
+    assert t % 8 == 0, f"cache T dim must be a multiple of 8, got {t}"
     if block_t is None:
         # one block up to T=1024 (fewer grid cells measurably beats
         # smaller streamed blocks here — per-cell overhead dominates at
