@@ -140,8 +140,9 @@ def _train_transformer(args) -> int:
         f"seq_len={args.seq_len} experts={cfg.n_experts} fsdp={args.fsdp}"
     )
     if args.status_port is not None:
-        port = svc.start_rest_api(args.status_port)
-        print(f"status REST on http://127.0.0.1:{port}/statetracker")
+        port = svc.start_rest_api(args.status_port, host=args.status_host)
+        shown = "127.0.0.1" if args.status_host == "0.0.0.0" else args.status_host
+        print(f"status REST on http://{shown}:{port}/statetracker")
     svc.phase = "train"
 
     rng = np.random.default_rng(0)
@@ -236,8 +237,9 @@ def cmd_train(args) -> int:
 
     svc = ClusterService()
     if args.status_port is not None:
-        port = svc.start_rest_api(args.status_port)
-        print(f"status REST on http://127.0.0.1:{port}/statetracker")
+        port = svc.start_rest_api(args.status_port, host=args.status_host)
+        shown = "127.0.0.1" if args.status_host == "0.0.0.0" else args.status_host
+        print(f"status REST on http://{shown}:{port}/statetracker")
     mesh = data_parallel_mesh()
     trainer = DataParallelTrainer(loss_fn, mesh=mesh)
     state = trainer.init(params)
@@ -308,6 +310,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     t.add_argument("--save-every", type=int, default=50)
     t.add_argument("--status-port", type=int, default=None)
+    t.add_argument(
+        "--status-host", default="127.0.0.1",
+        help="interface for the status REST server (default loopback; "
+        "multi-host deployments pass 0.0.0.0 or a routable address so "
+        "remote workers reach the heartbeat/control endpoints)",
+    )
     # transformer-only knobs
     t.add_argument("--text", default=None, help="path to a byte-level corpus")
     t.add_argument("--steps", type=int, default=200)

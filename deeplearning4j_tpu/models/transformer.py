@@ -287,6 +287,15 @@ def _apply_rope(x, cos, sin):
 _DECODE_PAD_T = 8
 
 
+def _flash_seq_ok(t: int) -> bool:
+    """Sequence lengths the training flash kernel accepts: sublane-
+    aligned (%8 — Mosaic rejects e.g. a 100-row block shape on real
+    TPU) and either one block (<=128) or lane-block-aligned (%128). ONE
+    predicate shared by the training block (which raises) and bulk
+    prefill (which falls back to dense) so the rule cannot drift."""
+    return t % 8 == 0 and (t <= 128 or t % 128 == 0)
+
+
 def _flash_blocks(t: int) -> tuple[int, int]:
     """(block_q, block_k) for the flash kernel at sequence length t:
     512/1024 preferred (measured fastest on v5e for T~1024-8192), falling
@@ -429,10 +438,10 @@ def transformer_apply(
             )
 
             t = q_h.shape[2]
-            if t > 128 and t % 128:
+            if not _flash_seq_ok(t):
                 raise ValueError(
-                    f"use_flash needs seq len <= 128 or a multiple of "
-                    f"128, got {t}"
+                    f"use_flash needs a seq len that is a multiple of 8 "
+                    f"and either <= 128 or a multiple of 128, got {t}"
                 )
             # no attn_out naming here: the kernel's own flash_out
             # residual is the saveable (naming both would store the
@@ -752,12 +761,11 @@ def _decode_builder(cfg: TransformerConfig):
                 kv, kv_rows.astype(kv.dtype), (0, 0, 0, 0)
             )
             k_h, v_h = _expand_kv(cfg, k_r, v_r)
-            if cfg.use_flash and (tp <= 128 or tp % 128 == 0):
+            if cfg.use_flash and _flash_seq_ok(tp):
                 # keep long-prompt prefill O(T) like training — dense
                 # attention would materialize (B, H, Tp, Tp) scores.
-                # Prompts of arbitrary length (not %128) fall back to
-                # dense; training's stricter shape rule doesn't apply
-                # to inference inputs.
+                # Prompts of other lengths fall back to dense (inference
+                # inputs are arbitrary; training raises instead).
                 from deeplearning4j_tpu.ops.pallas_kernels import (
                     flash_attention_trainable,
                 )

@@ -382,8 +382,10 @@ class Word2Vec:
                     )
             tail = len(ins) - n_full * b
             if train_tail and tail:
-                # pad the final partial batch; it trains via the
-                # per-batch step (the queue is flushed right after)
+                # pad the final partial batch; on the scan path it is
+                # queued and flushed through dispatch_queue with the
+                # other buffered batches, otherwise it trains via the
+                # per-batch step
                 pad = b - tail
                 ins_t = np.concatenate([ins[-tail:], np.zeros(pad, np.int32)])
                 tgts_t = np.concatenate([tgts[-tail:], np.zeros(pad, np.int32)])

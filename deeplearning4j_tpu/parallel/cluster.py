@@ -107,12 +107,17 @@ class ClusterService:
         }
 
     # -- REST (≙ StateTrackerDropWizardResource) ---------------------------
-    def start_rest_api(self, port: int = 0) -> int:
+    def start_rest_api(self, port: int = 0, host: str = "127.0.0.1") -> int:
         """GET status + POST *control*, matching the reference resource
         (StateTrackerDropWizardResource.java:29-96: GET jobs/phase/
         minibatch/printmodel, POST minibatch). POSTs change live trainer
         behavior: the training loop reads ``minibatch`` each step and
-        ``early_stop`` on its report cadence."""
+        ``early_stop`` on its report cadence.
+
+        ``host`` defaults to loopback for safety; multi-host
+        deployments pass a routable interface (e.g. ``"0.0.0.0"``) so
+        workers on other machines can reach the heartbeat/control
+        endpoints."""
         service = self
 
         from deeplearning4j_tpu.utils.httpjson import (
@@ -187,7 +192,7 @@ class ClusterService:
                     return self._json(200, {"phase": service.phase})
                 return self._json(404, {"error": "unknown endpoint"})
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._server = ThreadingHTTPServer((host, port), Handler)
         thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         thread.start()
         return self._server.server_address[1]

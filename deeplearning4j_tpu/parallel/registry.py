@@ -94,9 +94,18 @@ class RegistryServer:
                         cur = server._store.get(key)
                         if cur is None or cur.value != req["if_owner"]:
                             return self._send(409, {"error": "not owner"})
-                    server._store[key] = _Entry(
-                        req.get("value"), req.get("ttl")
-                    )
+                        # a renew that omits "value" or "ttl" keeps the
+                        # held one — overwriting value with null would
+                        # orphan the lock (the real holder's later
+                        # renews would 409 against owner None), and
+                        # overwriting ttl with null would silently turn
+                        # the lease into a never-expiring lock
+                        value = req.get("value", cur.value)
+                        ttl = req.get("ttl", cur.ttl)
+                    else:
+                        value = req.get("value")
+                        ttl = req.get("ttl")
+                    server._store[key] = _Entry(value, ttl)
                 self._send(200)
 
             do_POST = do_PUT  # tolerate POST for the same write semantics

@@ -34,9 +34,13 @@ if "host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-from jax._src import xla_bridge as _xb  # noqa: E402
+try:  # private API: absent/renamed on newer jax is fine — with
+    # jax_platforms=cpu the axon factory is merely unused
+    from jax._src import xla_bridge as _xb  # noqa: E402
 
-_xb._backend_factories.pop("axon", None)
+    _xb._backend_factories.pop("axon", None)
+except (ImportError, AttributeError):
+    pass
 import jax.numpy as jnp
 import numpy as np
 import optax

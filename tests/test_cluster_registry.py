@@ -118,6 +118,26 @@ def test_registry_lock_mutual_exclusion_and_lease_expiry():
         assert not third.acquire(timeout=0.4)
         fresh.renew()  # holder renews fine
         fresh.release()
+
+        # a raw if_owner renew that omits "value" must PRESERVE the held
+        # value, not overwrite the owner with null (which would 409 the
+        # real holder's every later renew/release) — ADVICE r2
+        import json as _json
+        import urllib.request as _rq
+
+        holder = NetworkRegistry(addr, "job").lock(
+            "raw", owner="h", lease=30.0
+        )
+        assert holder.acquire(timeout=1.0)
+        req = _rq.Request(
+            f"http://{addr}/kv/job/lock/raw",
+            data=_json.dumps({"if_owner": "h", "ttl": 30.0}).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        _rq.urlopen(req, timeout=5).read()
+        holder.renew()  # would raise LeaseLostError before the fix
+        holder.release()
     finally:
         server.stop()
 
