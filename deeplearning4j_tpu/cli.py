@@ -27,6 +27,16 @@ def _add_distributed_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--process-id", type=int, default=None)
 
 
+def _start_status_rest(svc, args) -> None:
+    """Start the status/control REST server when --status-port is given,
+    printing a reachable URL (0.0.0.0 binds display as loopback)."""
+    if args.status_port is None:
+        return
+    port = svc.start_rest_api(args.status_port, host=args.status_host)
+    shown = "127.0.0.1" if args.status_host == "0.0.0.0" else args.status_host
+    print(f"status REST on http://{shown}:{port}/statetracker")
+
+
 def _train_transformer(args) -> int:
     """Byte-level char-LM training for the flagship transformer: composed
     dp x tp mesh (``--tp``), optional MoE experts / FSDP, checkpointing via
@@ -139,10 +149,7 @@ def _train_transformer(args) -> int:
         f"n_heads={cfg.n_heads} d_ff={cfg.d_ff} vocab={cfg.vocab_size} "
         f"seq_len={args.seq_len} experts={cfg.n_experts} fsdp={args.fsdp}"
     )
-    if args.status_port is not None:
-        port = svc.start_rest_api(args.status_port, host=args.status_host)
-        shown = "127.0.0.1" if args.status_host == "0.0.0.0" else args.status_host
-        print(f"status REST on http://{shown}:{port}/statetracker")
+    _start_status_rest(svc, args)
     svc.phase = "train"
 
     rng = np.random.default_rng(0)
@@ -236,10 +243,7 @@ def cmd_train(args) -> int:
         return 2
 
     svc = ClusterService()
-    if args.status_port is not None:
-        port = svc.start_rest_api(args.status_port, host=args.status_host)
-        shown = "127.0.0.1" if args.status_host == "0.0.0.0" else args.status_host
-        print(f"status REST on http://{shown}:{port}/statetracker")
+    _start_status_rest(svc, args)
     mesh = data_parallel_mesh()
     trainer = DataParallelTrainer(loss_fn, mesh=mesh)
     state = trainer.init(params)
@@ -328,8 +332,8 @@ def main(argv: list[str] | None = None) -> int:
     t.add_argument("--fsdp", action="store_true")
     t.add_argument(
         "--flash", action="store_true",
-        help="pallas flash attention (seq-len <= 128 or a multiple of "
-        "128); the TPU perf recipe — see PERF.md",
+        help="pallas flash attention (seq-len a multiple of 8, and "
+        "<= 128 or a multiple of 128); the TPU perf recipe — see PERF.md",
     )
     t.add_argument(
         "--remat", action="store_true",

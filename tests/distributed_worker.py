@@ -154,13 +154,43 @@ def main() -> int:
     )
     tstep, tinit, tshard = transformer_train_step(tmesh, tcfg)
     tparams, topt = tinit(jax.random.key(5))
-    ttoks = tshard(
-        np.random.default_rng(5).integers(0, 32, (8, 9)).astype(np.int32)
+    toks_np = np.random.default_rng(5).integers(0, 32, (8, 9)).astype(
+        np.int32
     )
+    ttoks = tshard(toks_np)
     tl = None
     for _ in range(3):
         tparams, topt, tl = tstep(tparams, topt, ttoks)
     print(f"TPLOSS={float(tl):.10f}", flush=True)
+
+    # ZeRO-3/FSDP across the process boundary: params + optimizer state
+    # shard over the data axis (whose groups span both processes), so
+    # the per-step all-gathers and reduce-scatters ride the host-to-host
+    # transport — the DCN regime of a multi-slice pod.
+    fstep, finit, fshard = transformer_train_step(tmesh, tcfg, fsdp=True)
+    fparams, fopt = finit(jax.random.key(5))
+    ftoks = fshard(toks_np)
+    fl = None
+    for _ in range(3):
+        fparams, fopt, fl = fstep(fparams, fopt, ftoks)
+    print(f"FSDPLOSS={float(fl):.10f}", flush=True)
+
+    # MoE/EP across the process boundary: experts live one-per-device on
+    # the model axis, whose pairs span the two processes — the token
+    # all-to-all dispatch/combine crosses hosts.
+    import dataclasses
+
+    # field-for-field identical to tcfg apart from the experts — the
+    # MOELOSS comparison against the single-process reference depends
+    # on the two configs never drifting
+    mcfg = dataclasses.replace(tcfg, n_experts=2)
+    mstep, minit, mshard = transformer_train_step(tmesh, mcfg)
+    mparams, mopt = minit(jax.random.key(5))
+    mtoks = mshard(toks_np)
+    ml = None
+    for _ in range(3):
+        mparams, mopt, ml = mstep(mparams, mopt, mtoks)
+    print(f"MOELOSS={float(ml):.10f}", flush=True)
     return 0
 
 

@@ -56,7 +56,7 @@ def _reference_loss():
     loss = None
     for _ in range(20):
         state, loss = trainer.step(state, xs, ys, jax.random.key(0))
-    return float(loss)
+    return float(loss), state.params
 
 
 @pytest.mark.slow
@@ -102,35 +102,75 @@ def test_two_process_distributed_training_matches_single_process():
         for out in outs:
             assert re.search(r"^ORBAX=ok$", out, re.M), out[-3000:]
         # cross-process tensor parallelism (TP pairs spanning the process
-        # boundary): replicated loss agrees across processes and with
-        # the single-process run of the same (4, 2) program
-        tp_losses = []
-        for out in outs:
-            m = re.search(r"^TPLOSS=([0-9.eE+-]+)$", out, re.M)
-            assert m, f"no TPLOSS line:\n{out[-3000:]}"
-            tp_losses.append(float(m.group(1)))
-        assert tp_losses[0] == tp_losses[1], tp_losses
+        # boundary), ZeRO-3/FSDP (param shards + gathers spanning hosts)
+        # and MoE/EP (expert all-to-all spanning hosts): each replicated
+        # loss agrees across processes and with the single-process run
+        # of the same (4, 2) program
+        mode_losses = {}
+        for tag in ("TPLOSS", "FSDPLOSS", "MOELOSS"):
+            vals = []
+            for out in outs:
+                m = re.search(rf"^{tag}=([0-9.eE+-]+)$", out, re.M)
+                assert m, f"no {tag} line:\n{out[-3000:]}"
+                vals.append(float(m.group(1)))
+            assert vals[0] == vals[1], (tag, vals)
+            mode_losses[tag] = vals[0]
+
+        # the replicated loss must agree across processes exactly
+        assert losses[0] == losses[1], losses
+        # ... and match the single-process 8-device run of the same
+        # program (cross-process collectives may reassociate f32 sums ->
+        # tight tolerance, not bit-equality)
+        ref, ref_params = _reference_loss()
+        np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
+        # each parallelism mode matches the same program on a
+        # single-process (4, 2) mesh
+        for tag, (fsdp, n_experts) in (
+            ("TPLOSS", (False, 0)),
+            ("FSDPLOSS", (True, 0)),
+            ("MOELOSS", (False, 2)),
+        ):
+            np.testing.assert_allclose(
+                mode_losses[tag],
+                _reference_tp_loss(fsdp=fsdp, n_experts=n_experts),
+                rtol=1e-5,
+                atol=1e-6,
+                err_msg=tag,
+            )
+
+        # ELASTIC RESTORE: the orbax checkpoint was written by 2
+        # processes (each persisting only its own shards); this process
+        # — a different topology, 1 process x 8 devices — restores it
+        # onto its live mesh. The restored params must equal the
+        # identically-trained single-process reference.
+        from deeplearning4j_tpu.parallel.checkpoint import (
+            AsyncShardedCheckpointManager,
+        )
+
+        mgr = AsyncShardedCheckpointManager(orbax_dir)
+        try:
+            res = mgr.restore_latest(ref_params)
+            assert res is not None, "workers wrote no orbax checkpoint"
+            restored, meta = res
+            assert int(meta["step"]) == 20
+            import jax as _jax
+
+            for a, b in zip(
+                _jax.tree.leaves(restored), _jax.tree.leaves(ref_params)
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+                )
+        finally:
+            mgr.close()
     finally:
         server.stop()
         import shutil
 
         shutil.rmtree(orbax_dir, ignore_errors=True)
 
-    # the replicated loss must agree across processes exactly
-    assert losses[0] == losses[1], losses
-    # ... and match the single-process 8-device run of the same program
-    # (cross-process collectives may reassociate f32 sums -> tight
-    # tolerance, not bit-equality)
-    ref = _reference_loss()
-    np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
-    # the cross-process-TP transformer run matches the same program on a
-    # single-process (4, 2) mesh
-    np.testing.assert_allclose(
-        tp_losses[0], _reference_tp_loss(), rtol=1e-5, atol=1e-6
-    )
 
-
-def _reference_tp_loss():
+def _reference_tp_loss(fsdp: bool = False, n_experts: int = 0):
     import jax
     import numpy as np_
 
@@ -141,10 +181,10 @@ def _reference_tp_loss():
 
     tcfg = TransformerConfig(
         vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
-        max_len=16,
+        max_len=16, n_experts=n_experts,
     )
     tmesh = mesh_lib.dp_mp_mesh(4, 2)
-    tstep, tinit, tshard = transformer_train_step(tmesh, tcfg)
+    tstep, tinit, tshard = transformer_train_step(tmesh, tcfg, fsdp=fsdp)
     tparams, topt = tinit(jax.random.key(5))
     ttoks = tshard(
         np_.random.default_rng(5).integers(0, 32, (8, 9)).astype(np_.int32)
