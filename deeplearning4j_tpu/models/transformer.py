@@ -298,10 +298,12 @@ def _flash_seq_ok(t: int) -> bool:
 
 def _flash_blocks(t: int) -> tuple[int, int]:
     """(block_q, block_k) for the flash kernel at sequence length t:
-    512/1024 preferred (measured fastest on v5e for T~1024-8192), falling
-    back to the largest candidate that divides t — callers only
-    guarantee t <= 128 or t % 128 == 0. ONE implementation shared by the
-    training block and bulk prefill so kernel selection cannot drift."""
+    1024/1024 preferred (measured fastest on v5e at T=1024 AND T=8192
+    with the fused backward kernel — the r2 512/1024 winner predates
+    it), falling back to the largest candidate that divides t — callers
+    only guarantee t <= 128 or t % 128 == 0. ONE implementation shared
+    by the training block and bulk prefill so kernel selection cannot
+    drift."""
 
     def pick(pref: int) -> int:
         if t <= pref:
@@ -311,7 +313,7 @@ def _flash_blocks(t: int) -> tuple[int, int]:
                 return b
         return 128  # t % 128 == 0 guaranteed by the callers
 
-    return pick(512), pick(1024)
+    return pick(1024), pick(1024)
 
 
 def _project_qkv(cfg: TransformerConfig, p, h_in):

@@ -60,17 +60,51 @@ def _vmem(shape, dtype):
 
 def _kv_block_visible(q_start, k_start, block_q: int):
     """Causal visibility of a KV block to a Q block: it contributes iff
-    its first column is <= the Q block's last row. Shared by all three
-    kernels so the skip bound cannot drift."""
+    its first column is <= the Q block's last row. Shared by the forward
+    and fused-backward kernels so the skip bound cannot drift."""
     return k_start <= q_start + block_q - 1
 
 
-def _dim_semantics(interpret):
+def _kv_block_fully_visible(q_start, k_start, block_q: int, block_k: int):
+    """True when every (row, col) pair in the tile is causally visible
+    (the tile lies entirely on/below the diagonal) — such tiles skip the
+    bias construction entirely. The O(T^2) softmax bookkeeping is VPU-
+    bound at long T (measured ~half the kernel time at T=8192), and the
+    two iota builds + compare + add of the bias are a meaningful share;
+    only diagonal-crossing tiles (a 1/n_blocks fraction) pay them."""
+    return k_start + block_k - 1 <= q_start
+
+
+def _causal_dispatch(
+    compute, causal: bool, q_start, k_start, block_q: int, block_k: int
+):
+    """Emit ``compute(masked)`` under the tile's causal class — fully
+    visible (no bias), diagonal-crossing (bias), or invisible (skipped).
+    ONE dispatch shared by the forward and fused-backward kernels so the
+    masking classes cannot drift between the two."""
+    if not causal:
+        compute(False)
+        return
+    full = _kv_block_fully_visible(q_start, k_start, block_q, block_k)
+
+    @pl.when(full)
+    def _full():
+        compute(False)
+
+    @pl.when(
+        jnp.logical_and(
+            _kv_block_visible(q_start, k_start, block_q),
+            jnp.logical_not(full),
+        )
+    )
+    def _diag():
+        compute(True)
+
+
+def _dim_semantics(interpret, semantics=("parallel", "parallel", "arbitrary")):
     if interpret or pltpu is None:
         return None
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary")
-    )
+    return pltpu.CompilerParams(dimension_semantics=semantics)
 
 
 def _flash_fwd_stream_kernel(
@@ -88,36 +122,39 @@ def _flash_fwd_stream_kernel(
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    def compute():
-        q = q_ref[0]
-        s = jnp.dot(
-            q, k_ref[0].T, preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
+    def compute(masked: bool):
+        # scale folded into the Q tile: one multiply over (block_q, d)
+        # instead of a full (block_q, block_k) pass on the f32 scores —
+        # the softmax bookkeeping is VPU-bound at long T
+        q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype))
+        s = jnp.dot(q, k_ref[0].T, preferred_element_type=jnp.float32)
+        if masked:
             s = s + _causal_bias(q_start, k_start, block_q, block_k)
         m_prev = m_s[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        # softmax tail in the VALUE dtype (bf16 on TPU): p is consumed
+        # by a bf16 MXU dot anyway, so rounding before the exp instead
+        # of after costs the same ~1% relative error while halving the
+        # VPU cost of the sub/exp over the (block_q, block_k) scores —
+        # the dominant non-MXU work at long T. f32 under interpret/f32
+        # compute, so tests see identical math.
+        p = jnp.exp(
+            (s - m_new[:, None]).astype(v_ref.dtype)
+        )
         corr = jnp.exp(m_prev - m_new)
-        l_s[:, 0] = corr * l_s[:, 0] + jnp.sum(p, axis=-1)
-        # PV dot with p cast to the value dtype (bf16 on TPU): operands
-        # must stay low-precision to hit the MXU at full rate — an f32
-        # matmul runs at a fraction of peak on v5e. The accumulator is
-        # f32 (preferred_element_type + f32 scratch), the standard
+        l_s[:, 0] = corr * l_s[:, 0] + jnp.sum(
+            p, axis=-1, dtype=jnp.float32
+        )
+        # PV dot with bf16 operands: f32 matmul operands would fall off
+        # the MXU fast path on v5e. The accumulator is f32
+        # (preferred_element_type + f32 scratch), the standard
         # flash-bf16 recipe.
         acc_s[:] = corr[:, None] * acc_s[:] + jnp.dot(
-            p.astype(v_ref.dtype), v_ref[0],
-            preferred_element_type=jnp.float32,
+            p, v_ref[0], preferred_element_type=jnp.float32,
         )
         m_s[:, 0] = m_new
 
-    if causal:
-        # blocks entirely above the diagonal contribute nothing
-        @pl.when(_kv_block_visible(q_start, k_start, block_q))
-        def _guarded():
-            compute()
-    else:
-        compute()
+    _causal_dispatch(compute, causal, q_start, k_start, block_q, block_k)
 
     @pl.when(kk == n_k - 1)
     def _finalize():
@@ -163,63 +200,25 @@ def _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal):
     )(qf, kf, vf)
 
 
-def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s,
-    *, block_q: int, block_k: int, n_k: int, scale: float, causal: bool,
-):
-    """dQ contribution of one KV block, accumulated in scratch."""
-    kk = pl.program_id(2)
-    q_start = pl.program_id(1) * block_q
-    k_start = kk * block_k
-
-    @pl.when(kk == 0)
-    def _init():
-        dq_s[:] = jnp.zeros_like(dq_s)
-
-    def compute():
-        # operands stay in their storage dtype (bf16 on TPU) — only the
-        # accumulation is f32 (preferred_element_type); f32 matmul
-        # operands would fall off the MXU fast path
-        q = q_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0, :, 0]
-        delta = delta_ref[0, :, 0]
-        k_blk = k_ref[0]
-        v_blk = v_ref[0]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = s + _causal_bias(q_start, k_start, block_q, block_k)
-        p = jnp.exp(s - lse[:, None])
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(k_blk.dtype)
-        dq_s[:] = dq_s[:] + jnp.dot(
-            ds, k_blk, preferred_element_type=jnp.float32
-        ) * scale
-
-    if causal:
-        @pl.when(_kv_block_visible(q_start, k_start, block_q))
-        def _guarded():
-            compute()
-    else:
-        compute()
-
-    @pl.when(kk == n_k - 1)
-    def _finalize():
-        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
-
-
-def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_s, dv_s,
+def _flash_bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref, dk_s, dv_s,
     *, block_q: int, block_k: int, n_q: int, scale: float, causal: bool,
 ):
-    """dK/dV contribution of one Q block, accumulated in scratch.
+    """One (kv block, q block) step of the FUSED backward pass.
 
-    Grid is (bh, kv_blocks, q_blocks): the K/V block is the parallel dim,
-    Q streams sequentially.
+    The split dQ / dK-dV kernels each recomputed s, p and dp — 7 full
+    T^2 matmul passes plus a double run of the VPU-bound softmax
+    bookkeeping (bias, exp, sub). Fusing computes them once: 5 matmul
+    passes and one exp per tile. Grid is (bh, kv_blocks, q_blocks), Q
+    innermost: dK/dV accumulate in VMEM scratch and finalize once per
+    KV block; the dQ tile accumulates in its f32 HBM output block,
+    revisited once per KV block (read-modify-write; kv block 0 — always
+    causally visible — initializes it).
     """
+    kk = pl.program_id(1)
     qq = pl.program_id(2)
-    k_start = pl.program_id(1) * block_k
+    k_start = kk * block_k
     q_start = qq * block_q
 
     @pl.when(qq == 0)
@@ -227,16 +226,21 @@ def _flash_bwd_dkv_kernel(
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    def compute():
-        # bf16 operands + f32 accumulation, as in the dq kernel
+    def compute(masked: bool):
+        # operands stay in their storage dtype (bf16 on TPU) — only the
+        # accumulation is f32 (preferred_element_type); f32 matmul
+        # operands would fall off the MXU fast path. Scale folds into
+        # the Q tile (s = (q*scale)@k^T), which also absorbs the dk
+        # scale (dk = scale * ds^T @ q = ds^T @ (q*scale)); the dq
+        # contribution is rescaled on its small (block_q, d) tile.
         k_blk = k_ref[0]
         v_blk = v_ref[0]
-        q = q_ref[0]
+        q = q_ref[0] * jnp.asarray(scale, q_ref.dtype)
         do = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-        if causal:
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if masked:
             s = s + _causal_bias(q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dv_s[:] = dv_s[:] + jnp.dot(
@@ -246,15 +250,22 @@ def _flash_bwd_dkv_kernel(
         ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_s[:] = dk_s[:] + jnp.dot(
             ds.T, q, preferred_element_type=jnp.float32
+        )
+        dq_c = jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32
         ) * scale
 
-    if causal:
-        # q blocks strictly above this K block see none of it
-        @pl.when(_kv_block_visible(q_start, k_start, block_q))
-        def _guarded():
-            compute()
-    else:
-        compute()
+        @pl.when(kk == 0)
+        def _dq_init():
+            dq_ref[0] = dq_c
+
+        @pl.when(kk != 0)
+        def _dq_acc():
+            dq_ref[0] = dq_ref[0] + dq_c
+
+    # invisible tiles are skipped wholesale (their dq tile is left
+    # untouched — kv block 0, always visible, initialized it)
+    _causal_dispatch(compute, causal, q_start, k_start, block_q, block_k)
 
     @pl.when(qq == n_q - 1)
     def _finalize():
@@ -318,33 +329,16 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[..., None]
 
-    dq = pl.pallas_call(
+    dq32, dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-            n_k=n_k, scale=scale, causal=causal,
-        ),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
-        grid=(bh, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-        scratch_shapes=[_vmem((block_q, d), jnp.float32)],
-        compiler_params=_dim_semantics(interpret),
-        interpret=interpret,
-    )(qf, kf, vf, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            _flash_bwd_fused_kernel, block_q=block_q, block_k=block_k,
             n_q=n_q, scale=scale, causal=causal,
         ),
         out_shape=(
+            # dq accumulates across kv blocks in its HBM tile: f32 so
+            # repeated read-modify-writes don't round at bf16 (cast once
+            # below, matching the old scratch-accumulator precision)
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
             jax.ShapeDtypeStruct((bh, t, d), vf.dtype),
         ),
@@ -358,6 +352,7 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
             pl.BlockSpec((1, block_q, 1), lambda i, j, qq: (i, qq, 0)),
         ],
         out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j, qq: (i, qq, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
         ),
@@ -365,10 +360,15 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
             _vmem((block_k, d), jnp.float32),
             _vmem((block_k, d), jnp.float32),
         ],
-        compiler_params=_dim_semantics(interpret),
+        # the kv dim must be SEQUENTIAL (not "parallel"): dq tiles are
+        # revisited and accumulated across it — a megacore split over
+        # kv (v4/v5p) would race the read-modify-writes
+        compiler_params=_dim_semantics(
+            interpret, ("parallel", "arbitrary", "arbitrary")
+        ),
         interpret=interpret,
     )(qf, kf, vf, do, lse, delta)
-    return dq, dk, dv
+    return dq32.astype(qf.dtype), dk, dv
 
 
 _flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)

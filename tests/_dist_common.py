@@ -1,0 +1,22 @@
+"""Shapes and seeds shared by the 2-process distributed worker and the
+in-test single-process reference runs.
+
+The cross-process TP/FSDP/MoE assertions compare losses between
+`distributed_worker.py` and `test_distributed_multiprocess.py`; both
+sides MUST train the identical program, so the config/seed/batch
+literals live here once. (Both import sites resolve this module from
+the tests directory: the worker runs as a script from it, and pytest
+puts non-package test dirs on sys.path.)
+"""
+
+#: tiny transformer used by the cross-process TP / FSDP / MoE checks
+TINY_TRANSFORMER = dict(
+    vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32, max_len=16,
+)
+#: param-init key and token-batch rng seed
+TRANSFORMER_SEED = 5
+#: (batch, seq+1) of the token batch drawn with TRANSFORMER_SEED
+TOKENS_SHAPE = (8, 9)
+#: experts for the MoE mode — must equal the model-axis size of the
+#: (4, 2) mesh both sides build
+N_EXPERTS = 2

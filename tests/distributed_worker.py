@@ -148,14 +148,17 @@ def main() -> int:
         [[devs[i], devs[i + local]] for i in range(local)], dtype=object
     )
     tmesh = Mesh(grid, (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))
-    tcfg = TransformerConfig(
-        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
-        max_len=16,
+    from _dist_common import (
+        N_EXPERTS, TINY_TRANSFORMER, TOKENS_SHAPE, TRANSFORMER_SEED,
     )
+
+    tcfg = TransformerConfig(**TINY_TRANSFORMER)
     tstep, tinit, tshard = transformer_train_step(tmesh, tcfg)
-    tparams, topt = tinit(jax.random.key(5))
-    toks_np = np.random.default_rng(5).integers(0, 32, (8, 9)).astype(
-        np.int32
+    tparams, topt = tinit(jax.random.key(TRANSFORMER_SEED))
+    toks_np = (
+        np.random.default_rng(TRANSFORMER_SEED)
+        .integers(0, tcfg.vocab_size, TOKENS_SHAPE)
+        .astype(np.int32)
     )
     ttoks = tshard(toks_np)
     tl = None
@@ -183,7 +186,7 @@ def main() -> int:
     # field-for-field identical to tcfg apart from the experts — the
     # MOELOSS comparison against the single-process reference depends
     # on the two configs never drifting
-    mcfg = dataclasses.replace(tcfg, n_experts=2)
+    mcfg = dataclasses.replace(tcfg, n_experts=N_EXPERTS)
     mstep, minit, mshard = transformer_train_step(tmesh, mcfg)
     mparams, mopt = minit(jax.random.key(5))
     mtoks = mshard(toks_np)

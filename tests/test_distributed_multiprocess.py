@@ -125,10 +125,12 @@ def test_two_process_distributed_training_matches_single_process():
         np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
         # each parallelism mode matches the same program on a
         # single-process (4, 2) mesh
+        from _dist_common import N_EXPERTS
+
         for tag, (fsdp, n_experts) in (
             ("TPLOSS", (False, 0)),
             ("FSDPLOSS", (True, 0)),
-            ("MOELOSS", (False, 2)),
+            ("MOELOSS", (False, N_EXPERTS)),
         ):
             np.testing.assert_allclose(
                 mode_losses[tag],
@@ -174,20 +176,22 @@ def _reference_tp_loss(fsdp: bool = False, n_experts: int = 0):
     import jax
     import numpy as np_
 
+    from _dist_common import (
+        TINY_TRANSFORMER, TOKENS_SHAPE, TRANSFORMER_SEED,
+    )
     from deeplearning4j_tpu.models.transformer import (
         TransformerConfig, transformer_train_step,
     )
     from deeplearning4j_tpu.parallel import mesh as mesh_lib
 
-    tcfg = TransformerConfig(
-        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
-        max_len=16, n_experts=n_experts,
-    )
+    tcfg = TransformerConfig(**TINY_TRANSFORMER, n_experts=n_experts)
     tmesh = mesh_lib.dp_mp_mesh(4, 2)
     tstep, tinit, tshard = transformer_train_step(tmesh, tcfg, fsdp=fsdp)
-    tparams, topt = tinit(jax.random.key(5))
+    tparams, topt = tinit(jax.random.key(TRANSFORMER_SEED))
     ttoks = tshard(
-        np_.random.default_rng(5).integers(0, 32, (8, 9)).astype(np_.int32)
+        np_.random.default_rng(TRANSFORMER_SEED)
+        .integers(0, tcfg.vocab_size, TOKENS_SHAPE)
+        .astype(np_.int32)
     )
     tl = None
     for _ in range(3):
