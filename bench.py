@@ -5,6 +5,8 @@ per workload — the driver's round record captures all of them:
 
 - ``lenet``       LeNet-MNIST samples/sec/chip (f32, reference parity dtype)
 - ``alexnet``     AlexNet-CIFAR10 samples/sec/chip (bf16 mixed)
+- ``resnet``      ResNet-20 CIFAR samples/sec/chip (bf16, BN state
+                  threaded through the scanned step)
 - ``word2vec``    hierarchical-softmax kernel pairs/sec/chip
 - ``transformer`` GPT-2-small-class LM (d768/12L/12H/T1024/V50304, bf16,
                   flash attention + selective remat) tokens/sec/chip with
@@ -378,6 +380,51 @@ def _bench_decode(args):
     )
 
 
+def _bench_resnet(args):
+    """ResNet-20 (He CIFAR recipe) training throughput — the modern CNN
+    family the reference's era lacked (its conv story stops at
+    forward-only ConvolutionDownSampleLayer.java:113). BN state threads
+    through the scanned step, so this exercises the stateful-layer path
+    the LeNet/AlexNet workloads don't."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.alexnet import synthetic_cifar
+    from deeplearning4j_tpu.models.resnet import (
+        ResNetConfig,
+        init_resnet,
+        resnet_run_steps,
+    )
+    import optax
+
+    cfg = ResNetConfig()  # ResNet-20, 10 classes
+    ds = synthetic_cifar(n=args.batch)
+    x = jnp.asarray(
+        np.asarray(ds.features, np.float32).reshape(-1, 32, 32, 3)
+    )
+    y = jnp.asarray(np.asarray(ds.labels, np.float32))
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    run_steps = resnet_run_steps(cfg, optimizer)
+    params, state = init_resnet(jax.random.key(0), cfg)
+    holder = {"s": (params, state, optimizer.init(params)), "l": None}
+
+    def run(_i):
+        p, s, o, losses = run_steps(*holder["s"], x, y, STEPS)
+        holder["s"] = (p, s, o)
+        holder["l"] = losses
+
+    def drain():
+        out = np.asarray(holder["l"])
+        assert np.isfinite(out).all(), "resnet bench loss non-finite"
+
+    reps, dt = _run_window(args, run, drain, windows=4)
+    return (
+        args.batch * STEPS * reps / dt,
+        "resnet20_cifar10_train_samples_per_sec_per_chip",
+    )
+
+
 def _build(model: str, batch: int):
     """(params, loss_fn, x, y, metric_name) for the chosen workload."""
     import jax.numpy as jnp
@@ -409,15 +456,16 @@ def _build(model: str, batch: int):
 
 
 _ALL_WORKLOADS = (
-    "lenet", "alexnet", "word2vec", "transformer", "transformer-flash-8k",
-    "transformer-decode",
+    "lenet", "alexnet", "resnet", "word2vec", "transformer",
+    "transformer-flash-8k", "transformer-decode",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
 # where the model is too small to be MXU-bound (lenet: bf16 measured
 # 0.94x) or parity matters (word2vec exp-table semantics)
 _AUTO_DTYPE = {
-    "lenet": "f32", "alexnet": "bf16", "word2vec": "f32",
+    "lenet": "f32", "alexnet": "bf16", "resnet": "bf16",
+    "word2vec": "f32",
     "transformer": "bf16", "transformer-flash-8k": "bf16",
     "transformer-decode": "bf16",
 }
@@ -506,6 +554,14 @@ def _run_one_inner(args, jax) -> None:
     from deeplearning4j_tpu.parallel import mesh as mesh_lib
 
     n_chips = len(jax.devices())
+
+    if args.model == "resnet":
+        if args.scaling:
+            raise SystemExit("--scaling is implemented for the "
+                             "DataParallelTrainer workloads (lenet/alexnet)")
+        per_chip, metric = _bench_resnet(args)
+        _report(args, per_chip, metric, jax)
+        return
 
     if args.model == "word2vec":
         if args.scaling:

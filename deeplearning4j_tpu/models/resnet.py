@@ -107,14 +107,37 @@ def _conv(x, w):
     )
 
 
-def _batch_norm(x, p, s, train: bool, momentum: float, eps: float):
+def _batch_norm(
+    x, p, s, train: bool, momentum: float, eps: float,
+    axis_name: str | None = None,
+):
     """Returns (y, new_state). Statistics in f32 regardless of compute
     dtype; train mode normalizes with batch stats and rolls the running
-    averages, eval mode uses the running stats."""
+    averages, eval mode uses the running stats.
+
+    Sync-BN: under jit/pjit with a batch-sharded input, the means below
+    are GLOBAL by construction — XLA inserts the cross-replica reduction,
+    so the pjit path is synchronized batch norm already (locked by
+    ``test_resnet.py::test_pjit_batch_norm_is_sync``). ``axis_name`` is
+    for the per-replica regimes (``shard_map``/``pmap``), where each
+    replica sees only its shard: the two raw moments are ``pmean``-ed
+    over the named axis (pmean of per-shard VARIANCES would be wrong —
+    E[x^2] - E[x]^2 needs globally-averaged moments)."""
     x32 = x.astype(jnp.float32)
     if train:
-        mean = jnp.mean(x32, axis=(0, 1, 2))
-        var = jnp.var(x32, axis=(0, 1, 2))
+        if axis_name is not None:
+            # cross-replica: pmean the raw moments, then E[x^2]-E[x]^2.
+            # (The moment form cancels catastrophically for large-mean
+            # near-constant channels, so it is confined to this path
+            # where per-shard variances cannot be combined directly.)
+            mean = lax.pmean(jnp.mean(x32, axis=(0, 1, 2)), axis_name)
+            sq = lax.pmean(
+                jnp.mean(jnp.square(x32), axis=(0, 1, 2)), axis_name
+            )
+            var = sq - jnp.square(mean)
+        else:
+            mean = jnp.mean(x32, axis=(0, 1, 2))
+            var = jnp.var(x32, axis=(0, 1, 2))
         new_s = {
             "mean": momentum * s["mean"] + (1 - momentum) * mean,
             "var": momentum * s["var"] + (1 - momentum) * var,
@@ -126,18 +149,22 @@ def _batch_norm(x, p, s, train: bool, momentum: float, eps: float):
     return y.astype(x.dtype), new_s
 
 
-def resnet_apply(cfg: ResNetConfig, train: bool):
-    """apply(params, state, x NHWC) -> (logits f32, new_state)."""
+def resnet_apply(cfg: ResNetConfig, train: bool, axis_name: str | None = None):
+    """apply(params, state, x NHWC) -> (logits f32, new_state).
+
+    ``axis_name`` enables cross-replica sync-BN inside per-replica
+    regimes (shard_map/pmap); the plain jit/pjit path is sync already
+    (see ``_batch_norm``)."""
 
     def block_fn(x, bp, bs):
         h, bs1 = _batch_norm(
             _conv(x, bp["conv1"]), bp["bn1"], bs["bn1"], train,
-            cfg.bn_momentum, cfg.bn_eps,
+            cfg.bn_momentum, cfg.bn_eps, axis_name,
         )
         h = jax.nn.relu(h)
         h, bs2 = _batch_norm(
             _conv(h, bp["conv2"]), bp["bn2"], bs["bn2"], train,
-            cfg.bn_momentum, cfg.bn_eps,
+            cfg.bn_momentum, cfg.bn_eps, axis_name,
         )
         skip = _conv(x, bp["proj"]) if "proj" in bp else x
         return jax.nn.relu(h + skip), {"bn1": bs1, "bn2": bs2}
@@ -148,7 +175,7 @@ def resnet_apply(cfg: ResNetConfig, train: bool):
         h = _conv(x, params["stem"]["w"])
         h, stem_s = _batch_norm(
             h, params["stem"]["bn"], state["stem"], train,
-            cfg.bn_momentum, cfg.bn_eps,
+            cfg.bn_momentum, cfg.bn_eps, axis_name,
         )
         h = jax.nn.relu(h)
         new_state = {"stem": stem_s, "stages": []}
@@ -181,28 +208,66 @@ def resnet_apply(cfg: ResNetConfig, train: bool):
     return apply
 
 
-def resnet_train_step(cfg: ResNetConfig, optimizer=None):
-    """Jitted supervised step threading the BN state:
-    ``step(params, state, opt_state, x, y) ->
-    (params, state, opt_state, loss)``; labels one-hot (B, C)."""
-    optimizer = optimizer or optax.sgd(0.1, momentum=0.9)
+def _supervised_loss(cfg: ResNetConfig):
     apply = resnet_apply(cfg, train=True)
 
     def loss_fn(params, state, x, y):
         logits, new_state = apply(params, state, x)
         return optax.softmax_cross_entropy(logits, y).mean(), new_state
 
+    return loss_fn
+
+
+def _sgd_update(optimizer, loss_fn, params, state, opt_state, x, y):
+    (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, state, x, y
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, new_state, opt_state, loss
+
+
+def resnet_train_step(cfg: ResNetConfig, optimizer=None):
+    """Jitted supervised step threading the BN state:
+    ``step(params, state, opt_state, x, y) ->
+    (params, state, opt_state, loss)``; labels one-hot (B, C)."""
+    optimizer = optimizer or optax.sgd(0.1, momentum=0.9)
+    loss_fn = _supervised_loss(cfg)
+
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, state, opt_state, x, y):
-        (loss, new_state), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(params, state, x, y)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, new_state, opt_state, loss
+        return _sgd_update(
+            optimizer, loss_fn, params, state, opt_state, x, y
+        )
 
     def init(key):
         params, state = init_resnet(key, cfg)
         return params, state, optimizer.init(params)
 
     return step, init
+
+
+def resnet_run_steps(cfg: ResNetConfig, optimizer=None):
+    """One jitted program scanning n supervised steps — the bench/tight-
+    loop form (per-step dispatch would be tunnel-latency-bound for a
+    model this small; the carry is a few MB so the scan copy is noise).
+    ``run(params, state, opt_state, x, y, n) ->
+    (params, state, opt_state, losses (n,))``."""
+    optimizer = optimizer or optax.sgd(0.1, momentum=0.9)
+    loss_fn = _supervised_loss(cfg)
+
+    @functools.partial(
+        jax.jit, static_argnums=(5,), donate_argnums=(0, 1, 2)
+    )
+    def run(params, state, opt_state, x, y, n: int):
+        def body(carry, _):
+            p, s, o = carry
+            p, s, o, loss = _sgd_update(optimizer, loss_fn, p, s, o, x, y)
+            return (p, s, o), loss
+
+        (params, state, opt_state), losses = lax.scan(
+            body, (params, state, opt_state), None, length=n
+        )
+        return params, state, opt_state, losses
+
+    return run

@@ -132,25 +132,20 @@ def _flash_fwd_stream_kernel(
             s = s + _causal_bias(q_start, k_start, block_q, block_k)
         m_prev = m_s[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        # softmax tail in the VALUE dtype (bf16 on TPU): p is consumed
-        # by a bf16 MXU dot anyway, so rounding before the exp instead
-        # of after costs the same ~1% relative error while halving the
-        # VPU cost of the sub/exp over the (block_q, block_k) scores —
-        # the dominant non-MXU work at long T. f32 under interpret/f32
-        # compute, so tests see identical math.
-        p = jnp.exp(
-            (s - m_new[:, None]).astype(v_ref.dtype)
-        )
+        p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m_prev - m_new)
-        l_s[:, 0] = corr * l_s[:, 0] + jnp.sum(
-            p, axis=-1, dtype=jnp.float32
-        )
-        # PV dot with bf16 operands: f32 matmul operands would fall off
-        # the MXU fast path on v5e. The accumulator is f32
-        # (preferred_element_type + f32 scratch), the standard
-        # flash-bf16 recipe.
+        l_s[:, 0] = corr * l_s[:, 0] + jnp.sum(p, axis=-1)
+        # PV dot with p cast to the value dtype (bf16 on TPU): operands
+        # must stay low-precision to hit the MXU at full rate — an f32
+        # matmul runs at a fraction of peak on v5e. The accumulator is
+        # f32 (preferred_element_type + f32 scratch), the standard
+        # flash-bf16 recipe. (A bf16 sub/exp variant measured
+        # perf-NEUTRAL on v5e while costing ~1% extra error and an
+        # lse inconsistent with the backward's f32 p recompute — not
+        # worth it.)
         acc_s[:] = corr[:, None] * acc_s[:] + jnp.dot(
-            p, v_ref[0], preferred_element_type=jnp.float32,
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32,
         )
         m_s[:, 0] = m_new
 

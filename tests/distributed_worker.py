@@ -171,7 +171,7 @@ def main() -> int:
     # the per-step all-gathers and reduce-scatters ride the host-to-host
     # transport — the DCN regime of a multi-slice pod.
     fstep, finit, fshard = transformer_train_step(tmesh, tcfg, fsdp=True)
-    fparams, fopt = finit(jax.random.key(5))
+    fparams, fopt = finit(jax.random.key(TRANSFORMER_SEED))
     ftoks = fshard(toks_np)
     fl = None
     for _ in range(3):
@@ -188,7 +188,7 @@ def main() -> int:
     # on the two configs never drifting
     mcfg = dataclasses.replace(tcfg, n_experts=N_EXPERTS)
     mstep, minit, mshard = transformer_train_step(tmesh, mcfg)
-    mparams, mopt = minit(jax.random.key(5))
+    mparams, mopt = minit(jax.random.key(TRANSFORMER_SEED))
     mtoks = mshard(toks_np)
     ml = None
     for _ in range(3):
