@@ -289,9 +289,38 @@ def cmd_status(args) -> int:
 
 
 def cmd_provision(args) -> int:
-    from deeplearning4j_tpu.utils.cloud_io import render_tpu_vm_provision
+    """Render or EXECUTE cluster provisioning (≙ ClusterSetup.java:24,
+    which actually SSHes; default here is the safe dry run — every
+    command that would execute is printed; --execute runs them)."""
+    from deeplearning4j_tpu.utils.provision import (
+        ClusterSetup,
+        ClusterSpec,
+        RecordingRunner,
+        SubprocessRunner,
+    )
 
-    print(" ".join(render_tpu_vm_provision(args.name, args.accelerator_type, args.zone)))
+    spec = ClusterSpec(
+        name=args.name,
+        num_workers=args.num_workers,
+        accelerator_type=args.accelerator_type,
+        zone=args.zone,
+        master_script=args.master_script,
+        worker_script=args.worker_script,
+    )
+    runner = SubprocessRunner() if args.execute else RecordingRunner()
+    setup = ClusterSetup(spec, runner=runner)
+    try:
+        names = setup.provision()
+    except Exception as e:  # ProvisionError / subprocess timeouts
+        print(f"provisioning failed: {e}", file=sys.stderr)
+        return 1
+    if not args.execute:
+        for cmd in runner.commands:
+            print(" ".join(cmd))
+        print(f"# dry run: {len(runner.commands)} commands for "
+              f"{', '.join(names)} (pass --execute to run)")
+    else:
+        print(f"provisioned: {', '.join(names)}")
     return 0
 
 
@@ -360,10 +389,22 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--port", type=int, required=True)
     s.set_defaults(fn=cmd_status)
 
-    p = sub.add_parser("provision", help="render TPU-VM provisioning command")
+    p = sub.add_parser(
+        "provision",
+        help="provision a TPU-VM cluster (dry run by default; "
+        "--execute runs the gcloud/ssh commands)",
+    )
     p.add_argument("name")
     p.add_argument("--accelerator-type", default="v5litepod-8")
     p.add_argument("--zone", default="us-central1-a")
+    p.add_argument("--num-workers", type=int, default=0,
+                   help="worker VMs besides the master")
+    p.add_argument("--master-script", default=None,
+                   help="setup script run on the master after create")
+    p.add_argument("--worker-script", default=None,
+                   help="setup script run on each worker after create")
+    p.add_argument("--execute", action="store_true",
+                   help="actually run the commands (default: print them)")
     p.set_defaults(fn=cmd_provision)
 
     effective = argv if argv is not None else sys.argv[1:]

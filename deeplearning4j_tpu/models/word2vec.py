@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import logging
+from pathlib import Path
 from typing import Iterable
 
 import jax
@@ -551,16 +552,91 @@ class Word2Vec:
                 break
         return out
 
+    def _answer_analogy(self, normed, a, b, c, d):
+        """Top-1 analogy answer against a pre-normalized matrix:
+        True/False, or None when any word is out of vocabulary (the
+        word2vec.c skip convention). ONE implementation behind both
+        accuracy surfaces."""
+        va, vb, vc = (self.get_word_vector(w) for w in (a, b, c))
+        if va is None or vb is None or vc is None or d not in self.cache:
+            return None
+        q = vb - va + vc
+        sims = normed @ (q / (np.linalg.norm(q) + 1e-9))
+        exclude = {a, b, c}
+        for i in np.argsort(-sims):
+            w = self.cache.word_for(int(i))
+            if w not in exclude:
+                return w == d
+        return False
+
     def accuracy(self, questions: list[tuple[str, str, str, str]]) -> float:
         """Analogy accuracy a:b :: c:d (≙ WordVectors.accuracy)."""
-        correct = 0
-        total = 0
-        for a, b, c, d in questions:
-            va, vb, vc = (self.get_word_vector(w) for w in (a, b, c))
-            if va is None or vb is None or vc is None or d not in self.cache:
+        return self.accuracy_report({"all": questions})["TOTAL"]["accuracy"]
+
+    def accuracy_report(
+        self, path_or_categories
+    ) -> dict[str, dict[str, float]]:
+        """Per-category analogy report from the Google questions-words
+        format (≙ the reference's ``accuracy`` surface consuming the
+        standard file, WordVectorsImpl.java — which took the raw lines;
+        here also a path or pre-parsed {category: [(a,b,c,d), ...]}).
+
+        Returns ``{category: {"accuracy", "correct", "total",
+        "skipped"}}`` plus a ``"TOTAL"`` row; ``total`` counts questions
+        whose four words are all in vocabulary (the word2vec.c
+        convention — OOV questions are skipped, reported per category).
+        """
+        if isinstance(path_or_categories, (str, Path)):
+            cats = parse_questions_words(path_or_categories)
+        else:
+            cats = dict(path_or_categories)
+        # normalize the matrix ONCE: the standard questions-words file
+        # holds ~19.5K analogies, and a per-question _normed() would
+        # redo the full-vocab normalization every time
+        normed = self._normed()
+        report: dict[str, dict[str, float]] = {}
+        g_corr = g_tot = g_skip = 0
+        for cat, questions in cats.items():
+            corr = tot = skip = 0
+            for a, b, c, d in questions:
+                ans = self._answer_analogy(normed, a, b, c, d)
+                if ans is None:
+                    skip += 1
+                    continue
+                tot += 1
+                corr += bool(ans)
+            report[cat] = {
+                "accuracy": corr / tot if tot else 0.0,
+                "correct": corr, "total": tot, "skipped": skip,
+            }
+            g_corr += corr
+            g_tot += tot
+            g_skip += skip
+        report["TOTAL"] = {
+            "accuracy": g_corr / g_tot if g_tot else 0.0,
+            "correct": g_corr, "total": g_tot, "skipped": g_skip,
+        }
+        return report
+
+
+def parse_questions_words(path: str | Path) -> dict[str, list[tuple]]:
+    """Parse the Google ``questions-words.txt`` analogy format:
+    ``: category`` headers followed by ``a b c d`` lines (≙ the file the
+    reference's WordVectorsImpl accuracy surface consumes). Lines that
+    are not exactly four tokens are skipped, like word2vec.c's
+    compute-accuracy."""
+    cats: dict[str, list[tuple]] = {}
+    current = "uncategorized"
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
                 continue
-            total += 1
-            pred = self.words_nearest(vb - va + vc, top=1, exclude={a, b, c})
-            if pred and pred[0] == d:
-                correct += 1
-        return correct / total if total else 0.0
+            if line.startswith(":"):
+                current = line[1:].strip() or current
+                cats.setdefault(current, [])
+                continue
+            parts = line.split()
+            if len(parts) == 4:
+                cats.setdefault(current, []).append(tuple(parts))
+    return cats

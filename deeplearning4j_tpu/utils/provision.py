@@ -1,0 +1,230 @@
+"""Cluster provisioning EXECUTOR — actually runs host setup, not just
+renders it.
+
+≙ the reference's EC2 provisioning pair: ClusterSetup
+(deeplearning4j-scaleout/deeplearning4j-aws/.../provision/
+ClusterSetup.java:24 — spins up the boxes then provisions master +
+workers) and HostProvisioner (HostProvisioner.java:24 — per-host SSH
+session: runRemoteCommand, uploadForDeployment, uploadAndRun,
+addKeyFile). Re-expressed for the TPU world: hosts are TPU VMs created
+via gcloud, per-host commands ride ``gcloud compute tpus tpu-vm ssh`` /
+``scp`` (or plain ssh for generic hosts).
+
+Everything executes through an injectable :class:`CommandRunner`, so
+the zero-egress environment (and the tests) drive the full
+orchestration against a :class:`RecordingRunner` while production uses
+:class:`SubprocessRunner` — the reference hard-wired JSch and was
+untestable without live EC2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+import subprocess
+from typing import Protocol, Sequence
+
+from deeplearning4j_tpu.utils.cloud_io import render_tpu_vm_provision
+
+
+@dataclasses.dataclass
+class CommandResult:
+    returncode: int
+    stdout: str = ""
+    stderr: str = ""
+
+
+class CommandRunner(Protocol):
+    def run(self, argv: Sequence[str]) -> CommandResult: ...
+
+
+class SubprocessRunner:
+    """Executes for real (production path)."""
+
+    def __init__(self, timeout: float | None = 600.0):
+        self.timeout = timeout
+
+    def run(self, argv: Sequence[str]) -> CommandResult:
+        p = subprocess.run(
+            list(argv), capture_output=True, text=True,
+            timeout=self.timeout,
+        )
+        return CommandResult(p.returncode, p.stdout, p.stderr)
+
+
+class RecordingRunner:
+    """Records every command; used for --dry-run and offline tests.
+
+    ``responses`` optionally maps a substring to a canned
+    :class:`CommandResult` so failure paths are testable.
+    """
+
+    def __init__(self, responses: dict[str, CommandResult] | None = None):
+        self.commands: list[list[str]] = []
+        self.responses = responses or {}
+
+    def run(self, argv: Sequence[str]) -> CommandResult:
+        argv = list(argv)
+        self.commands.append(argv)
+        joined = " ".join(argv)
+        for key, result in self.responses.items():
+            if key in joined:
+                return result
+        return CommandResult(0)
+
+
+class ProvisionError(RuntimeError):
+    """A provisioning command failed; carries the failing argv + stderr."""
+
+
+def _check(runner: CommandRunner, argv: Sequence[str]) -> CommandResult:
+    res = runner.run(argv)
+    if res.returncode != 0:
+        raise ProvisionError(
+            f"command failed ({res.returncode}): "
+            f"{' '.join(argv)}\n{res.stderr[-2000:]}"
+        )
+    return res
+
+
+class HostProvisioner:
+    """Per-host command/upload session (≙ HostProvisioner.java:24).
+
+    ``tpu_vm=True`` routes through ``gcloud compute tpus tpu-vm ssh/scp``
+    (worker addressing on GCP); ``False`` uses plain ssh/scp for generic
+    hosts (the reference's regime).
+    """
+
+    def __init__(self, host: str, user: str | None = None,
+                 zone: str | None = None, key_file: str | None = None,
+                 tpu_vm: bool = False, runner: CommandRunner | None = None):
+        self.host = host
+        self.user = user
+        self.zone = zone
+        self.key_file = key_file
+        self.tpu_vm = tpu_vm
+        self.runner = runner or SubprocessRunner()
+
+    def _target(self) -> str:
+        return f"{self.user}@{self.host}" if self.user else self.host
+
+    def _ssh_base(self) -> list[str]:
+        if self.tpu_vm:
+            cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh",
+                   self._target()]
+            if self.zone:
+                cmd.append(f"--zone={self.zone}")
+            return cmd
+        cmd = ["ssh"]
+        if self.key_file:
+            cmd += ["-i", self.key_file]
+        return cmd + [self._target()]
+
+    def run_remote_command(self, command: str) -> CommandResult:
+        """≙ HostProvisioner.runRemoteCommand:89 (raises on rc != 0,
+        like the reference's 'exec did not succeed' path)."""
+        if self.tpu_vm:
+            argv = self._ssh_base() + [f"--command={command}"]
+        else:
+            argv = self._ssh_base() + [command]
+        return _check(self.runner, argv)
+
+    def upload_for_deployment(self, src: str, dst: str) -> None:
+        """≙ HostProvisioner.uploadForDeployment:138 (scp a file/dir)."""
+        if self.tpu_vm:
+            argv = ["gcloud", "compute", "tpus", "tpu-vm", "scp", src,
+                    f"{self._target()}:{dst}"]
+            if self.zone:
+                argv.append(f"--zone={self.zone}")
+        else:
+            argv = ["scp"]
+            if self.key_file:
+                argv += ["-i", self.key_file]
+            argv += [src, f"{self._target()}:{dst}"]
+        _check(self.runner, argv)
+
+    def upload_and_run(self, script: str, root_dir: str = "") -> None:
+        """≙ HostProvisioner.uploadAndRun:80 — upload a setup script,
+        chmod, execute."""
+        name = script.rsplit("/", 1)[-1]
+        remote = f"{root_dir.rstrip('/')}/{name}" if root_dir else name
+        self.upload_for_deployment(script, remote)
+        # execute by explicit path: absolute stays as-is, relative gets
+        # ./ — both quoted (an unquoted exec of a name with spaces would
+        # chmod one file and run another)
+        exec_path = remote if remote.startswith("/") else f"./{remote}"
+        self.run_remote_command(
+            f"chmod +x {shlex.quote(remote)} && {shlex.quote(exec_path)}"
+        )
+
+    def add_key_file(self, pub_key_path: str) -> None:
+        """≙ HostProvisioner.addKeyFile:148 — append a public key to
+        authorized_keys (read locally, appended remotely)."""
+        with open(pub_key_path) as f:
+            key = f.read().strip()
+        self.run_remote_command(
+            "mkdir -p ~/.ssh && "
+            f"echo {shlex.quote(key)} >> ~/.ssh/authorized_keys"
+        )
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """What to provision (≙ ClusterSetup's args4j options, TPU-flavored:
+    worker count, machine shape, region/zone, setup scripts)."""
+
+    name: str = "dl4j"
+    num_workers: int = 1
+    accelerator_type: str = "v5litepod-8"
+    zone: str = "us-central1-a"
+    version: str = "tpu-ubuntu2204-base"
+    master_script: str | None = None
+    worker_script: str | None = None
+
+
+class ClusterSetup:
+    """Provision a whole cluster (≙ ClusterSetup.java:24: create the
+    boxes, then provision master + workers with their setup scripts).
+
+    The master is ``<name>-master``; workers ``<name>-worker-<i>``. All
+    commands flow through the injected runner — pass a
+    :class:`RecordingRunner` for a dry run (the CLI's default)."""
+
+    def __init__(self, spec: ClusterSpec,
+                 runner: CommandRunner | None = None):
+        self.spec = spec
+        self.runner = runner or SubprocessRunner()
+
+    def _hosts(self) -> list[tuple[str, str | None]]:
+        s = self.spec
+        hosts = [(f"{s.name}-master", s.master_script)]
+        hosts += [
+            (f"{s.name}-worker-{i}", s.worker_script)
+            for i in range(s.num_workers)
+        ]
+        return hosts
+
+    def provision(self) -> list[str]:
+        """Create every VM, then run its setup script (when given).
+        Returns the provisioned host names, master first."""
+        s = self.spec
+        names = []
+        for host, script in self._hosts():
+            _check(self.runner, render_tpu_vm_provision(
+                host, accelerator_type=s.accelerator_type, zone=s.zone,
+                version=s.version,
+            ))
+            if script:
+                HostProvisioner(
+                    host, zone=s.zone, tpu_vm=True, runner=self.runner
+                ).upload_and_run(script)
+            names.append(host)
+        return names
+
+    def teardown(self) -> None:
+        """Delete every VM of the cluster (reverse order)."""
+        for host, _ in reversed(self._hosts()):
+            _check(self.runner, [
+                "gcloud", "compute", "tpus", "tpu-vm", "delete", host,
+                f"--zone={self.spec.zone}", "--quiet",
+            ])

@@ -1,0 +1,223 @@
+"""Round-3 parity dots: generic RecordReader bridge, provisioning
+executor, questions-words analogy report."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader,
+    ImageRecordReader,
+    RecordReaderDataSetIterator,
+    SVMLightRecordReader,
+)
+from deeplearning4j_tpu.utils.provision import (
+    ClusterSetup,
+    ClusterSpec,
+    CommandResult,
+    HostProvisioner,
+    ProvisionError,
+    RecordingRunner,
+)
+
+
+# -- RecordReader bridge (≙ RecordReaderDataSetIterator.java:48) -------------
+
+def test_csv_record_reader_batches_and_one_hot(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text(
+        "f1,f2,label\n"
+        "1.0,2.0,0\n"
+        "3.0,4.0,1\n"
+        "5.0,6.0,2\n"
+        "7.0,8.0,1\n"
+        "9.0,10.0,0\n"
+    )
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(p, skip_lines=1), batch_size=2,
+        label_index=-1, num_classes=3,
+    )
+    batches = list(it)
+    assert [len(b.features) for b in batches] == [2, 2, 1]  # short tail
+    np.testing.assert_array_equal(
+        batches[0].features, [[1.0, 2.0], [3.0, 4.0]]
+    )
+    np.testing.assert_array_equal(
+        batches[0].labels, [[1, 0, 0], [0, 1, 0]]
+    )
+    # reset() rewinds (≙ the DataSetIterator contract)
+    it.reset()
+    again = next(iter(it))
+    np.testing.assert_array_equal(again.features, batches[0].features)
+
+
+def test_csv_label_column_in_middle_and_unsupervised(tmp_path):
+    p = tmp_path / "mid.csv"
+    p.write_text("1,1,9\n0,2,8\n")
+    b = next(iter(RecordReaderDataSetIterator(
+        CSVRecordReader(p), batch_size=2, label_index=0, num_classes=2,
+    )))
+    np.testing.assert_array_equal(b.features, [[1, 9], [2, 8]])
+    np.testing.assert_array_equal(b.labels, [[0, 1], [1, 0]])
+    # unsupervised: labels mirror features (the reference's
+    # labelIndex < 0 branch)
+    u = next(iter(RecordReaderDataSetIterator(
+        CSVRecordReader(p), batch_size=2, label_index=None,
+    )))
+    np.testing.assert_array_equal(u.features, u.labels)
+
+
+def test_label_requires_num_classes():
+    with pytest.raises(ValueError, match="num_classes"):
+        RecordReaderDataSetIterator(CSVRecordReader("x.csv"), label_index=-1)
+
+
+def test_svmlight_record_reader(tmp_path):
+    p = tmp_path / "s.txt"
+    p.write_text(
+        "1 1:0.5 3:2.0  # comment\n"
+        "0 2:1.5\n"
+        "\n"
+    )
+    b = next(iter(RecordReaderDataSetIterator(
+        SVMLightRecordReader(p, n_features=3), batch_size=2,
+        label_index=-1, num_classes=2,
+    )))
+    np.testing.assert_allclose(b.features, [[0.5, 0, 2.0], [0, 1.5, 0]])
+    np.testing.assert_array_equal(b.labels, [[0, 1], [1, 0]])
+
+
+def test_image_record_reader_directory_labels(tmp_path):
+    PIL = pytest.importorskip("PIL.Image")
+    for cls, shade in (("cats", 40), ("dogs", 200)):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(2):
+            PIL.new("L", (4, 4), shade + i).save(d / f"{i}.png")
+    reader = ImageRecordReader(tmp_path, width=4, height=4)
+    assert reader.labels == ["cats", "dogs"]
+    b = next(iter(RecordReaderDataSetIterator(
+        reader, batch_size=4, label_index=-1, num_classes=2,
+    )))
+    assert b.features.shape == (4, 16)
+    np.testing.assert_array_equal(
+        np.argmax(b.labels, -1), [0, 0, 1, 1]
+    )
+    assert abs(float(b.features[0, 0]) - 40) < 2
+    assert abs(float(b.features[2, 0]) - 200) < 2
+
+
+# -- provisioning executor (≙ ClusterSetup.java:24) ---------------------------
+
+def test_cluster_setup_provisions_master_and_workers(tmp_path):
+    script = tmp_path / "setup.sh"
+    script.write_text("#!/bin/sh\necho hi\n")
+    runner = RecordingRunner()
+    spec = ClusterSpec(
+        name="dl4j", num_workers=2, zone="us-z",
+        worker_script=str(script),
+    )
+    names = ClusterSetup(spec, runner=runner).provision()
+    assert names == ["dl4j-master", "dl4j-worker-0", "dl4j-worker-1"]
+    joined = [" ".join(c) for c in runner.commands]
+    # 3 creates + per worker (scp + ssh-run)
+    assert sum("tpus tpu-vm create" in c for c in joined) == 3
+    assert sum("tpus tpu-vm scp" in c for c in joined) == 2
+    run_cmds = [c for c in joined if "tpu-vm ssh" in c]
+    assert len(run_cmds) == 2
+    assert "chmod +x setup.sh && ./setup.sh" in run_cmds[0]
+    assert "--zone=us-z" in joined[0]
+
+
+def test_cluster_setup_teardown_reverses():
+    runner = RecordingRunner()
+    ClusterSetup(ClusterSpec(num_workers=1), runner=runner).teardown()
+    deleted = [c[5] for c in runner.commands]
+    assert deleted == ["dl4j-worker-0", "dl4j-master"]
+
+
+def test_provision_failure_raises_with_command():
+    runner = RecordingRunner(responses={
+        "create dl4j-worker-0": CommandResult(1, stderr="quota exceeded"),
+    })
+    with pytest.raises(ProvisionError, match="quota exceeded"):
+        ClusterSetup(ClusterSpec(num_workers=1), runner=runner).provision()
+
+
+def test_host_provisioner_ssh_forms(tmp_path):
+    key = tmp_path / "id.pub"
+    key.write_text("ssh-ed25519 AAAA me@host\n")
+    runner = RecordingRunner()
+    # plain-ssh host (the reference's regime)
+    hp = HostProvisioner(
+        "10.0.0.5", user="ubuntu", key_file="/k", runner=runner
+    )
+    hp.run_remote_command("ls /")
+    hp.upload_for_deployment("/src/a.tar", "/dst/a.tar")
+    hp.add_key_file(str(key))
+    cmds = [" ".join(c) for c in runner.commands]
+    assert cmds[0] == "ssh -i /k ubuntu@10.0.0.5 ls /"
+    assert cmds[1] == "scp -i /k /src/a.tar ubuntu@10.0.0.5:/dst/a.tar"
+    assert "authorized_keys" in cmds[2]
+    # tpu-vm host routes through gcloud
+    tp = HostProvisioner("node-1", zone="z", tpu_vm=True, runner=runner)
+    tp.run_remote_command("hostname")
+    assert runner.commands[-1][:6] == [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", "node-1",
+    ]
+
+
+# -- questions-words analogy report (≙ WordVectorsImpl accuracy) -------------
+
+def test_questions_words_parse_and_report(tmp_path):
+    from deeplearning4j_tpu.models.word2vec import parse_questions_words
+
+    qw = tmp_path / "questions-words.txt"
+    qw.write_text(
+        ": capital-common-countries\n"
+        "athens greece paris france\n"
+        "paris france athens greece\n"
+        ": family\n"
+        "king queen man woman\n"
+        "king queen oov1 oov2\n"
+        "not four tokens here really extra\n"
+    )
+    cats = parse_questions_words(qw)
+    assert set(cats) == {"capital-common-countries", "family"}
+    assert len(cats["capital-common-countries"]) == 2
+    assert cats["family"][0] == ("king", "queen", "man", "woman")
+
+    # a vocabulary engineered so the analogies resolve exactly:
+    # vec(b) - vec(a) + vec(c) == vec(d) by construction
+    class _FakeCache:
+        def __init__(self, words):
+            self._w = list(words)
+
+        def index_of(self, w):
+            return self._w.index(w) if w in self._w else -1
+
+        def word_for(self, i):
+            return self._w[i]
+
+        def __contains__(self, w):
+            return w in self._w
+
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+
+    words = ["athens", "greece", "paris", "france",
+             "king", "queen", "man", "woman"]
+    base = np.eye(4, dtype=np.float32)  # country-ness, city-ness axes
+    vecs = {
+        "athens": base[0], "greece": base[0] + base[1],
+        "paris": base[2], "france": base[2] + base[1],
+        "king": base[0] * 2, "queen": base[0] * 2 + base[3],
+        "man": base[2] * 2, "woman": base[2] * 2 + base[3],
+    }
+    w2v = Word2Vec.__new__(Word2Vec)
+    w2v.cache = _FakeCache(words)
+    w2v.syn0 = np.stack([vecs[w] for w in words])
+    report = w2v.accuracy_report(qw)
+    assert report["capital-common-countries"]["accuracy"] == 1.0
+    assert report["family"]["correct"] == 1
+    assert report["family"]["skipped"] == 1  # the OOV question
+    assert report["TOTAL"]["total"] == 3
+    assert report["TOTAL"]["accuracy"] == 1.0
