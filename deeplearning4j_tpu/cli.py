@@ -315,8 +315,10 @@ def cmd_provision(args) -> int:
         print(f"provisioning failed: {e}", file=sys.stderr)
         return 1
     if not args.execute:
+        import shlex
+
         for cmd in runner.commands:
-            print(" ".join(cmd))
+            print(shlex.join(cmd))  # paste-safe: spaced args stay quoted
         print(f"# dry run: {len(runner.commands)} commands for "
               f"{', '.join(names)} (pass --execute to run)")
     else:

@@ -95,7 +95,17 @@ class SVMLightRecordReader:
                 row[-1] = self.label_map.get(raw, raw)
                 for kv in parts[1:]:
                     idx, val = kv.split(":")
-                    row[int(idx) - 1] = float(val)
+                    j = int(idx) - 1
+                    if not 0 <= j < self.n_features:
+                        # an unchecked index would silently overwrite
+                        # the label slot (0-based files, or indices past
+                        # n_features)
+                        raise ValueError(
+                            f"feature index {idx} outside 1..="
+                            f"{self.n_features} (SVMLight indices are "
+                            "1-based)"
+                        )
+                    row[j] = float(val)
                 yield row
 
     def reset(self) -> None:

@@ -155,12 +155,111 @@ class LSTMLayer:
         beam_size: int = 5,
         n_steps: int = 20,
     ) -> list[tuple[list[int], float]]:
-        """Beam-search decode (≙ LSTM.BeamSearch.search:257-320).
+        """Beam-search decode (≙ LSTM.BeamSearch.search:257-320),
+        TPU-first: the whole search is ONE ``lax.scan`` over decode
+        steps (the transformer's M26 pattern) — per step the W beams
+        tick as one batch, the top W continuations are drawn from the
+        W x V candidate pool (a superset of the reference's per-beam
+        top-W pools, same global top-W), and hidden state / token
+        history are gathered to the surviving parents. Finished beams
+        (stop token 0) hold their score by contributing a single
+        re-emit-stop candidate, exactly the host oracle's pass-through.
 
         ``seed`` is the first input row; ``embeddings[i]`` is the input
         row fed when token i was emitted (the reference's ``ws``).
-        Runs host-side over a jitted tick; index 0 is the stop token.
+        Returns the host-API list of (token_list, logp), best first —
+        ``beam_search_host`` is the (slow, Python-loop) oracle the
+        parity test pins this against.
         """
+        d = self.hidden_size(conf)
+        w = beam_size
+        v = conf.n_out
+        # one compiled runner per (shape, width, length) — params are a
+        # traced ARGUMENT, and the jitted closure is cached so repeated
+        # decodes don't re-trace/re-compile the whole scan every call
+        cache_key = (conf.activation, d, v, w, n_steps)
+        run = self._beam_runners.get(cache_key)
+        if run is None:
+            run = self._build_beam_runner(conf, d, v, w, n_steps)
+            self._beam_runners[cache_key] = run
+
+        tokens, scores = run(params, seed, embeddings)
+        tokens = tokens.tolist()
+        out = []
+        for idxs, logp in zip(tokens, scores.tolist()):
+            if 0 in idxs:  # trim the padding re-emits after the stop
+                idxs = idxs[: idxs.index(0) + 1]
+            out.append((idxs, float(logp)))
+        return out
+
+    _beam_runners: dict = {}
+
+    def _build_beam_runner(self, conf, d, v, w, n_steps):
+        def batch_tick(params, x, h, c):
+            i, f, o, g = self._gates(conf, params[RECURRENT_WEIGHTS], x, h)
+            c2 = i * g + f * c
+            h2 = self._hout(conf, o, c2)
+            return self.decode(params, conf, h2), h2, c2
+
+        @jax.jit
+        def run(params, seed, embeddings):
+            _, h0, c0 = batch_tick(
+                params, seed[None, :], jnp.zeros((1, d), seed.dtype),
+                jnp.zeros((1, d), seed.dtype),
+            )
+            h = jnp.tile(h0, (w, 1))
+            c = jnp.tile(c0, (w, 1))
+            # beam 0 is live; the rest start dead so the first step
+            # draws W distinct tokens from beam 0 (the oracle's single
+            # initial beam)
+            scores = jnp.full((w,), -jnp.inf).at[0].set(0.0)
+            prev = jnp.zeros((w,), jnp.int32)
+            finished = jnp.zeros((w,), bool)
+            tokens = jnp.zeros((w, n_steps), jnp.int32)
+            # a finished beam's only candidate: re-emit the stop token
+            # at unchanged score
+            fin_row = jnp.full((v,), -jnp.inf).at[0].set(0.0)
+
+            def step(carry, i_step):
+                tokens, scores, h, c, prev, finished = carry
+                y, h2, c2 = batch_tick(params, embeddings[prev], h, c)
+                logp = jax.nn.log_softmax(y, axis=-1)
+                cand = scores[:, None] + jnp.where(
+                    finished[:, None], fin_row[None, :], logp
+                )
+                top_scores, flat = lax.top_k(cand.reshape(-1), w)
+                parent = flat // v
+                tok = (flat % v).astype(jnp.int32)
+                keep = finished[parent][:, None]
+                h = jnp.where(keep, h[parent], h2[parent])
+                c = jnp.where(keep, c[parent], c2[parent])
+                tokens = lax.dynamic_update_index_in_dim(
+                    jnp.take(tokens, parent, axis=0), tok, i_step, axis=1
+                )
+                finished = finished[parent] | (tok == 0)
+                return (tokens, top_scores, h, c, tok, finished), None
+
+            (tokens, scores, *_), _ = lax.scan(
+                step, (tokens, scores, h, c, prev, finished),
+                jnp.arange(n_steps),
+            )
+            return tokens, scores  # top_k already sorts best-first
+
+        return run
+
+    def beam_search_host(
+        self,
+        params: Params,
+        conf: LayerConfig,
+        seed: jax.Array,
+        embeddings: jax.Array,
+        beam_size: int = 5,
+        n_steps: int = 20,
+    ) -> list[tuple[list[int], float]]:
+        """The reference-shaped host-loop beam search (≙ LSTM.BeamSearch
+        .search:257-320: Python list of beams, per-beam tick, host
+        sort) — kept as the TEST ORACLE for the scanned device version
+        above."""
         d = self.hidden_size(conf)
         tick = jax.jit(lambda x_t, h, c: self.tick(params, conf, x_t, h, c))
         y, h, c = tick(seed, jnp.zeros((d,)), jnp.zeros((d,)))

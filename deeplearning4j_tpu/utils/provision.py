@@ -82,7 +82,7 @@ def _check(runner: CommandRunner, argv: Sequence[str]) -> CommandResult:
     if res.returncode != 0:
         raise ProvisionError(
             f"command failed ({res.returncode}): "
-            f"{' '.join(argv)}\n{res.stderr[-2000:]}"
+            f"{shlex.join(argv)}\n{res.stderr[-2000:]}"
         )
     return res
 
@@ -222,9 +222,20 @@ class ClusterSetup:
         return names
 
     def teardown(self) -> None:
-        """Delete every VM of the cluster (reverse order)."""
+        """Delete every VM of the cluster (reverse order). Best-effort:
+        a failed delete must not leave the REMAINING (billed) VMs
+        running — every delete is attempted, failures collected and
+        raised once at the end."""
+        failures = []
         for host, _ in reversed(self._hosts()):
-            _check(self.runner, [
-                "gcloud", "compute", "tpus", "tpu-vm", "delete", host,
-                f"--zone={self.spec.zone}", "--quiet",
-            ])
+            try:
+                _check(self.runner, [
+                    "gcloud", "compute", "tpus", "tpu-vm", "delete", host,
+                    f"--zone={self.spec.zone}", "--quiet",
+                ])
+            except ProvisionError as e:
+                failures.append(str(e))
+        if failures:
+            raise ProvisionError(
+                f"{len(failures)} delete(s) failed:\n" + "\n".join(failures)
+            )
