@@ -109,6 +109,140 @@ class GCSBucketClient:
         self.bucket.blob(self._key(key)).upload_from_string(blob)
 
 
+class TransientStorageError(RuntimeError):
+    """A retryable remote-storage failure (network blip, truncated read,
+    checksum mismatch)."""
+
+
+class RetryingBucketClient:
+    """Retry/integrity decorator for any :class:`BucketClient` — the
+    operational hardening the reference's HDFS/S3 streams relied on
+    their client libraries for (BaseHdfsDataSetIterator /
+    BucketIterator simply trusted the SDK).
+
+    - every operation retries with exponential backoff on ANY exception
+      (bounded by ``retries``);
+    - ``put`` writes a ``<key>.sha256`` sidecar; ``get`` verifies it
+      when present, so a PARTIAL/truncated read surfaces as a
+      :class:`TransientStorageError` and is retried instead of feeding
+      corrupt bytes to ``np.load``;
+    - checksum sidecars are hidden from ``list_keys``.
+
+    ``sleep`` is injectable so tests run without real waits.
+    ``not_found`` is the exception type(s) the wrapped client raises for
+    a MISSING key — the default covers the local/dict doubles; wrapping
+    a real SDK client, pass its not-found type (e.g. botocore's
+    ``ClientError`` won't match ``FileNotFoundError``, and without it a
+    sidecar-less object would retry to exhaustion instead of falling
+    back to unverified reads).
+    """
+
+    SUFFIX = ".sha256"
+
+    def __init__(self, inner: BucketClient, retries: int = 4,
+                 backoff: float = 0.1, sleep=None,
+                 not_found: tuple = (FileNotFoundError, KeyError)):
+        import time as _time
+
+        self.inner = inner
+        self.retries = retries
+        self.backoff = backoff
+        self.sleep = sleep or _time.sleep
+        self.not_found = not_found
+        self.attempts = 0  # total low-level attempts (observability)
+
+    def _with_retries(self, fn):
+        delay = self.backoff
+        for attempt in range(self.retries + 1):
+            self.attempts += 1
+            try:
+                return fn()
+            except Exception:
+                if attempt == self.retries:
+                    raise
+                self.sleep(delay)
+                delay *= 2
+
+    def list_keys(self) -> list[str]:
+        keys = self._with_retries(self.inner.list_keys)
+        return [k for k in keys if not k.endswith(self.SUFFIX)]
+
+    def get(self, key: str) -> bytes:
+        import hashlib
+
+        def attempt():
+            blob = self.inner.get(key)
+            try:
+                digest = self.inner.get(key + self.SUFFIX).decode()
+            except self.not_found:
+                # sidecar genuinely ABSENT: integrity not enforced.
+                # Any other failure (a transient error on the sidecar
+                # fetch) must propagate and retry the whole attempt —
+                # swallowing it would silently disable verification
+                # and hand truncated bytes downstream.
+                return blob
+            actual = hashlib.sha256(blob).hexdigest()
+            if actual != digest:
+                raise TransientStorageError(
+                    f"checksum mismatch on {key} "
+                    f"(partial/corrupt read: {len(blob)} bytes)"
+                )
+            return blob
+
+        return self._with_retries(attempt)
+
+    def put(self, key: str, blob: bytes) -> None:
+        import hashlib
+
+        digest = hashlib.sha256(blob).hexdigest().encode()
+
+        def attempt():
+            self.inner.put(key, blob)
+            self.inner.put(key + self.SUFFIX, digest)
+
+        self._with_retries(attempt)
+
+
+class FlakyBucketClient:
+    """Fault-injection double: wraps any client and fails the first
+    ``fail_times`` calls of each (op, key) with a transient error;
+    ``truncate_first`` serves a HALF-READ blob on each key's first
+    successful ``get`` (caught by the retry client's checksum). The
+    zero-egress stand-in for a misbehaving remote store."""
+
+    def __init__(self, inner: BucketClient, fail_times: int = 0,
+                 truncate_first: bool = False):
+        self.inner = inner
+        self.fail_times = fail_times
+        self.truncate_first = truncate_first
+        self._counts: dict = {}
+
+    def _tick(self, op: str, key: str = "") -> int:
+        n = self._counts.get((op, key), 0)
+        self._counts[(op, key)] = n + 1
+        return n
+
+    def list_keys(self) -> list[str]:
+        if self._tick("list") < self.fail_times:
+            raise ConnectionError("injected: list failed")
+        return self.inner.list_keys()
+
+    def get(self, key: str) -> bytes:
+        n = self._tick("get", key)
+        if n < self.fail_times:
+            raise ConnectionError(f"injected: get {key} failed")
+        blob = self.inner.get(key)
+        if (self.truncate_first and n == self.fail_times
+                and not key.endswith(RetryingBucketClient.SUFFIX)):
+            return blob[: len(blob) // 2]  # partial read
+        return blob
+
+    def put(self, key: str, blob: bytes) -> None:
+        if self._tick("put", key) < self.fail_times:
+            raise ConnectionError(f"injected: put {key} failed")
+        self.inner.put(key, blob)
+
+
 def dataset_to_bytes(ds: DataSet) -> bytes:
     buf = io.BytesIO()
     np.savez_compressed(buf, features=ds.features, labels=ds.labels)

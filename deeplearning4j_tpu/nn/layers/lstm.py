@@ -174,10 +174,16 @@ class LSTMLayer:
         d = self.hidden_size(conf)
         w = beam_size
         v = conf.n_out
-        # one compiled runner per (shape, width, length) — params are a
-        # traced ARGUMENT, and the jitted closure is cached so repeated
-        # decodes don't re-trace/re-compile the whole scan every call
-        cache_key = (conf.activation, d, v, w, n_steps)
+        # one compiled runner per (shape, width, length, dtype policy) —
+        # params are a traced ARGUMENT, and the jitted closure is cached
+        # so repeated decodes don't re-trace/re-compile the whole scan
+        # every call. The policy is part of the key because decode's
+        # cast_to_compute bakes it into the trace.
+        policy = dtypes.get_policy()
+        cache_key = (
+            conf.activation, d, v, w, n_steps,
+            policy.compute_dtype, policy.param_dtype,
+        )
         run = self._beam_runners.get(cache_key)
         if run is None:
             run = self._build_beam_runner(conf, d, v, w, n_steps)

@@ -81,6 +81,7 @@ def test_rbm_unit_type_shapes(visible, hidden):
     assert h.shape == (8, 4)
 
 
+@pytest.mark.slow
 def test_rbm_cdk_learns_mnist_like_patterns():
     """CD-1 should reduce reconstruction error on structured binary data
     (≙ RBMTests' toy-matrix convergence checks)."""
@@ -128,6 +129,7 @@ def test_rbm_free_energy_prefers_training_patterns():
     assert fe_data < fe_noise, (fe_data, fe_noise)
 
 
+@pytest.mark.slow
 def test_autoencoder_denoising_learns():
     mod = layers.get("autoencoder")
     cfg = C.LayerConfig(

@@ -86,6 +86,7 @@ def test_dbn_pretrain_finetune_iris():
     assert ev.accuracy() > 0.85, ev.stats()
 
 
+@pytest.mark.slow
 def test_autoencoder_stack_pretrain():
     ds = fetchers.mnist(n=256).binarize()
     base = C.LayerConfig(

@@ -190,6 +190,7 @@ def test_glove_learns_cooccurrence_structure():
     assert g.similarity("day", "sun") > g.similarity("day", "moon")
 
 
+@pytest.mark.slow
 def test_paragraph_vectors_dbow():
     rng = np.random.default_rng(5)
     pairs = []

@@ -6,6 +6,7 @@ a right-branching fallback, making RNTN-on-raw-text structurally
 trivial."""
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nlp.parser import (
     CkyParser, Pcfg, bundled_treebank, default_parser,
@@ -71,6 +72,7 @@ def test_vectorizer_trees_are_structurally_nontrivial():
     assert all(_max_left_leaves(t) >= 5 for t in trees)
 
 
+@pytest.mark.slow
 def test_rntn_trains_on_pcfg_parsed_raw_text():
     from deeplearning4j_tpu.models.rntn import RNTN
 

@@ -300,6 +300,7 @@ def test_beam_search_width1_equals_greedy():
     assert np.isfinite(np.asarray(scores)).all()
 
 
+@pytest.mark.slow
 def test_beam_search_finds_higher_likelihood_than_greedy():
     from deeplearning4j_tpu.models.transformer import (
         transformer_beam_search,
