@@ -151,12 +151,17 @@ class RetryingBucketClient:
         self.not_found = not_found
         self.attempts = 0  # total low-level attempts (observability)
 
-    def _with_retries(self, fn):
+    def _with_retries(self, fn, fatal: tuple = ()):
+        """``fatal`` exception types propagate immediately — retrying a
+        genuinely-missing key would burn the whole backoff schedule per
+        miss for existence probes."""
         delay = self.backoff
         for attempt in range(self.retries + 1):
             self.attempts += 1
             try:
                 return fn()
+            except fatal:
+                raise
             except Exception:
                 if attempt == self.retries:
                     raise
@@ -189,7 +194,9 @@ class RetryingBucketClient:
                 )
             return blob
 
-        return self._with_retries(attempt)
+        # a missing PRIMARY key is fatal, not retryable (the sidecar
+        # not_found is handled inside attempt and never escapes)
+        return self._with_retries(attempt, fatal=self.not_found)
 
     def put(self, key: str, blob: bytes) -> None:
         import hashlib

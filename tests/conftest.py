@@ -31,6 +31,23 @@ from jax._src import xla_bridge as _xb  # noqa: E402
 
 _xb._backend_factories.pop("axon", None)
 
+# persistent compile cache, in a TESTS-OWN directory: the suite is
+# compile-dominated — transformer/MoE/FSDP programs cost 10-20s each to
+# build on CPU and are identical across runs; first run populates,
+# repeat runs cut minutes of wall time. The dir is separate from the
+# bench's .jax_cache and the TEST PROCESS IS THE ONLY WRITER: the
+# jax.distributed workers deadlock on the cache's cross-process write
+# coordination (measured: 2-proc bring-up hung to its 420s timeout),
+# and a killed concurrent writer once left an entry that ABORTED every
+# later compile — single-writer keeps kills harmless (orphaned temp at
+# worst) and scopes any corruption to this dir.
+from pathlib import Path as _Path  # noqa: E402
+
+_cache = _Path(__file__).resolve().parent.parent / ".jax_cache_tests"
+_cache.mkdir(exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", str(_cache))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
 
 

@@ -43,6 +43,12 @@ def main() -> int:
 
     _xb._backend_factories.pop("axon", None)
 
+    # NO persistent compile cache here, deliberately: under
+    # jax.distributed the cache's cross-process write coordination
+    # deadlocked the 2-process bring-up (worker hung until the 420s
+    # test timeout — measured). Only the pytest process itself caches
+    # (conftest); every subprocess worker runs uncached.
+
     from deeplearning4j_tpu.parallel.registry import NetworkRegistry
 
     reg = NetworkRegistry(registry_addr, job_id)

@@ -67,6 +67,10 @@ def main() -> int:
 
     _xb._backend_factories.pop("axon", None)
 
+    # NO persistent compile cache: this worker is SIGKILLed mid-run by
+    # design (the kill-restart test), and a kill during a cache write
+    # must never be able to poison the shared cache
+
     import jax.numpy as jnp
     import optax
 

@@ -146,8 +146,23 @@ def test_retrying_client_survives_faults_and_partial_reads(tmp_path):
     assert len(keys) == 3
     assert len(naps) >= 2  # backoff actually engaged
 
-    # reader: 1 injected connection failure per key + a truncated first
-    # successful read per key (checksum mismatch -> retry)
+    # reader A: truncation ONLY (no connection faults) — the sidecar
+    # checksum is the thing that must catch the half-read and drive the
+    # retry (with connection faults mixed in, the retry could be
+    # triggered by the fault instead and mask a broken checksum path)
+    reader_a = RetryingBucketClient(
+        FlakyBucketClient(store, fail_times=0, truncate_first=True),
+        sleep=naps.append,
+    )
+    before = reader_a.attempts
+    parts = list(CloudDataSetIterator(reader_a))
+    np.testing.assert_allclose(
+        np.concatenate([p.features for p in parts]), ds.features, rtol=1e-6
+    )
+    # each key's first get was truncated -> checksum retry happened
+    assert reader_a.attempts - before >= 2 * len(keys)
+
+    # reader B: connection failures AND truncation together
     reader = RetryingBucketClient(
         FlakyBucketClient(store, fail_times=1, truncate_first=True),
         sleep=naps.append,

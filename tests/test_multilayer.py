@@ -152,7 +152,7 @@ def test_conv_network_lenet_style():
         C.LayerConfig(layer_type="dense", n_in=8 * 12 * 12, n_out=64, activation="relu"),
         C.LayerConfig(
             layer_type="output", n_in=64, n_out=10, activation="softmax",
-            loss="MCXENT", lr=0.05, num_iterations=150, use_adagrad=True,
+            loss="MCXENT", lr=0.05, num_iterations=100, use_adagrad=True,
             optimization_algo=C.OptimizationAlgorithm.GRADIENT_DESCENT,
         ),
     ]

