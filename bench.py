@@ -14,7 +14,9 @@ per workload — the driver's round record captures all of them:
 - ``transformer-flash-8k`` long-context flash workload (T=8192) so
                   regressions in the pallas kernel path are visible
 - ``transformer-decode`` KV-cached sampling (bulk prefill + 64 decode
-                  steps) — serving-convention tokens/sec/chip
+                  steps, B=16) — serving-convention tokens/sec/chip
+- ``transformer-decode-b64`` the same at serving batch 64 (the
+                  throughput point; weight stream amortized 4x)
 
 ``--model X`` runs a single workload. ``--scaling`` reports 1->N-chip
 data-parallel efficiency (lenet/alexnet); ``--profile DIR`` captures an
@@ -299,12 +301,16 @@ def _bench_transformer(args, preset_name: str):
     return tokens_per_sec, f"{p['metric']}_train_tokens_per_sec_per_chip", mfu
 
 
-def _bench_decode(args):
+def _bench_decode(args, batch: int = 16, metric_suffix: str = ""):
     """KV-cached autoregressive decode throughput on the GPT-2-small
     config: bulk prefill (512 tokens) + 64 sampled steps per call, all
     inside one jitted program. Reported rate counts only the NEW tokens
     (prefill attributed as overhead — the conservative convention), so
-    the number is directly the serving-side tokens/sec/chip."""
+    the number is directly the serving-side tokens/sec/chip.
+
+    ``batch=16`` is the round-1 workload definition (latency-leaning);
+    the ``-b64`` variant is the throughput-serving point, where the
+    weight stream amortizes over 4x the tokens."""
     import functools
     import jax
     import jax.numpy as jnp
@@ -317,7 +323,7 @@ def _bench_decode(args):
     )
 
     p = _TRANSFORMER_PRESETS["transformer"]
-    batch, prompt_len, new = 16, 512, 64
+    prompt_len, new = 512, 64
     flash = p["flash"] if args.flash is None else args.flash
     cfg = TransformerConfig(
         vocab_size=p["vocab"], d_model=p["d_model"], n_heads=p["n_heads"],
@@ -375,7 +381,7 @@ def _bench_decode(args):
     )
     return (
         tok_per_sec,
-        "transformer_gpt2s_decode_tokens_per_sec_per_chip",
+        f"transformer_gpt2s_decode{metric_suffix}_tokens_per_sec_per_chip",
         mbu,
     )
 
@@ -457,7 +463,7 @@ def _build(model: str, batch: int):
 
 _ALL_WORKLOADS = (
     "lenet", "alexnet", "resnet", "word2vec", "transformer",
-    "transformer-flash-8k", "transformer-decode",
+    "transformer-flash-8k", "transformer-decode", "transformer-decode-b64",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -467,7 +473,7 @@ _AUTO_DTYPE = {
     "lenet": "f32", "alexnet": "bf16", "resnet": "bf16",
     "word2vec": "f32",
     "transformer": "bf16", "transformer-flash-8k": "bf16",
-    "transformer-decode": "bf16",
+    "transformer-decode": "bf16", "transformer-decode-b64": "bf16",
 }
 
 
@@ -571,10 +577,14 @@ def _run_one_inner(args, jax) -> None:
         _report(args, per_chip, metric, jax)
         return
 
-    if args.model == "transformer-decode":
+    if args.model in ("transformer-decode", "transformer-decode-b64"):
         if args.scaling:
             raise SystemExit("--scaling does not apply to decode")
-        per_chip, metric, mbu = _bench_decode(args)
+        b64 = args.model.endswith("b64")
+        per_chip, metric, mbu = _bench_decode(
+            args, batch=64 if b64 else 16,
+            metric_suffix="_b64" if b64 else "",
+        )
         _report(args, per_chip, metric, jax, util=mbu, util_key="mbu")
         return
 
@@ -694,7 +704,7 @@ def _report(
         key = f"{args.model}_samples_per_sec_per_chip"
     is_transformer = (
         args.model in _TRANSFORMER_PRESETS
-        or args.model == "transformer-decode"
+        or args.model.startswith("transformer-decode")
     )
     comparable = is_transformer or args.batch == BATCH
     baseline = records.get(platform, {}).get(key) if comparable else None

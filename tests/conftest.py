@@ -43,14 +43,17 @@ _xb._backend_factories.pop("axon", None)
 # worst) and scopes any corruption to this dir.
 from pathlib import Path as _Path  # noqa: E402
 
-if "PYTEST_XDIST_WORKER" not in os.environ:
-    # enforce the single-writer invariant, don't just document it:
-    # xdist workers would all point at the same dir and recreate the
-    # concurrent-writer hazard above
-    _cache = _Path(__file__).resolve().parent.parent / ".jax_cache_tests"
-    _cache.mkdir(exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", str(_cache))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# enforce the single-writer invariant, don't just document it: xdist
+# workers each write to their OWN suffixed dir (worker names gw0/gw1/...
+# are stable across runs, so warm-cache benefits persist) instead of
+# racing on one
+_suffix = os.environ.get("PYTEST_XDIST_WORKER", "")
+_cache = _Path(__file__).resolve().parent.parent / (
+    ".jax_cache_tests" + (f"_{_suffix}" if _suffix else "")
+)
+_cache.mkdir(exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", str(_cache))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import pytest  # noqa: E402
 
