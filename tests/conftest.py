@@ -31,29 +31,15 @@ from jax._src import xla_bridge as _xb  # noqa: E402
 
 _xb._backend_factories.pop("axon", None)
 
-# persistent compile cache, in a TESTS-OWN directory: the suite is
-# compile-dominated — transformer/MoE/FSDP programs cost 10-20s each to
-# build on CPU and are identical across runs; first run populates,
-# repeat runs cut minutes of wall time. The dir is separate from the
-# bench's .jax_cache and the TEST PROCESS IS THE ONLY WRITER: the
-# jax.distributed workers deadlock on the cache's cross-process write
-# coordination (measured: 2-proc bring-up hung to its 420s timeout),
-# and a killed concurrent writer once left an entry that ABORTED every
-# later compile — single-writer keeps kills harmless (orphaned temp at
-# worst) and scopes any corruption to this dir.
-from pathlib import Path as _Path  # noqa: E402
-
-# enforce the single-writer invariant, don't just document it: xdist
-# workers each write to their OWN suffixed dir (worker names gw0/gw1/...
-# are stable across runs, so warm-cache benefits persist) instead of
-# racing on one
-_suffix = os.environ.get("PYTEST_XDIST_WORKER", "")
-_cache = _Path(__file__).resolve().parent.parent / (
-    ".jax_cache_tests" + (f"_{_suffix}" if _suffix else "")
-)
-_cache.mkdir(exist_ok=True)
-jax.config.update("jax_compilation_cache_dir", str(_cache))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# NO persistent compile cache for the suite, deliberately (tried in
+# round 3, reverted): besides deadlocking jax.distributed workers on
+# its cross-process write coordination, a warm-cache READ of the
+# multiprocess test's SPMD train-step program intermittently hard-
+# ABORTED the whole pytest process (SIGABRT inside deserialization, on
+# entries a prior clean run wrote — reproduced twice). A ~90s wall-time
+# saving is not worth nondeterministic suite aborts; the bench keeps
+# its own .jax_cache, which has been stable all round (single process,
+# TPU programs only).
 
 import pytest  # noqa: E402
 
