@@ -32,9 +32,14 @@ def _start_status_rest(svc, args) -> None:
     printing a reachable URL (0.0.0.0 binds display as loopback)."""
     if args.status_port is None:
         return
-    port = svc.start_rest_api(args.status_port, host=args.status_host)
+    port = svc.start_rest_api(
+        args.status_port, host=args.status_host,
+        auth_token=getattr(args, "status_token", None),
+    )
     shown = "127.0.0.1" if args.status_host == "0.0.0.0" else args.status_host
     print(f"status REST on http://{shown}:{port}/statetracker")
+    if svc.auth_token is not None:
+        print(f"control POSTs require X-Auth-Token: {svc.auth_token}")
 
 
 def _train_transformer(args) -> int:
@@ -350,6 +355,11 @@ def main(argv: list[str] | None = None) -> int:
         help="interface for the status REST server (default loopback; "
         "multi-host deployments pass 0.0.0.0 or a routable address so "
         "remote workers reach the heartbeat/control endpoints)",
+    )
+    t.add_argument(
+        "--status-token", default=None,
+        help="shared secret for control POSTs (X-Auth-Token header); "
+        "auto-generated and logged when binding non-loopback without one",
     )
     # transformer-only knobs
     t.add_argument("--text", default=None, help="path to a byte-level corpus")

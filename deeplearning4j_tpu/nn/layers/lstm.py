@@ -184,10 +184,14 @@ class LSTMLayer:
             conf.activation, d, v, w, n_steps,
             policy.compute_dtype, policy.param_dtype,
         )
-        run = self._beam_runners.get(cache_key)
+        run = self._beam_runners.pop(cache_key, None)
         if run is None:
             run = self._build_beam_runner(conf, d, v, w, n_steps)
-            self._beam_runners[cache_key] = run
+            # bounded LRU: a process sweeping vocab sizes / beam widths /
+            # step counts must not grow compiled closures without limit
+            while len(self._beam_runners) >= self._BEAM_CACHE_MAX:
+                self._beam_runners.pop(next(iter(self._beam_runners)))
+        self._beam_runners[cache_key] = run  # (re)insert most-recent
 
         tokens, scores = run(params, seed, embeddings)
         tokens = tokens.tolist()
@@ -199,6 +203,7 @@ class LSTMLayer:
         return out
 
     _beam_runners: dict = {}
+    _BEAM_CACHE_MAX = 16
 
     def _build_beam_runner(self, conf, d, v, w, n_steps):
         def batch_tick(params, x, h, c):

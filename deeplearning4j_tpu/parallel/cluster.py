@@ -21,6 +21,7 @@ early-stop state + dropwizard REST) survives as this small service.
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import time
@@ -56,6 +57,9 @@ class ClusterService:
         # printmodel (≙ StateTrackerDropWizardResource.printModel); the
         # trainer sets it
         self.model_description = ""
+        # shared secret for control POSTs on non-loopback binds (set by
+        # start_rest_api; None = no auth, loopback-only default)
+        self.auth_token: str | None = None
         self._server: ThreadingHTTPServer | None = None
 
     # -- worker registry / heartbeats -------------------------------------
@@ -107,7 +111,12 @@ class ClusterService:
         }
 
     # -- REST (≙ StateTrackerDropWizardResource) ---------------------------
-    def start_rest_api(self, port: int = 0, host: str = "127.0.0.1") -> int:
+    def start_rest_api(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        auth_token: str | None = None,
+    ) -> int:
         """GET status + POST *control*, matching the reference resource
         (StateTrackerDropWizardResource.java:29-96: GET jobs/phase/
         minibatch/printmodel, POST minibatch). POSTs change live trainer
@@ -117,8 +126,24 @@ class ClusterService:
         ``host`` defaults to loopback for safety; multi-host
         deployments pass a routable interface (e.g. ``"0.0.0.0"``) so
         workers on other machines can reach the heartbeat/control
-        endpoints."""
+        endpoints.  On a non-loopback bind the control POSTs are
+        network-writable, so they require a shared secret: pass
+        ``auth_token`` (clients send it as the ``X-Auth-Token`` header)
+        or one is generated and logged.  GETs stay open (read-only
+        status)."""
         service = self
+        loopback = host in ("127.0.0.1", "localhost", "::1")
+        if auth_token is None and not loopback:
+            import logging
+            import secrets
+
+            auth_token = secrets.token_hex(16)
+            logging.getLogger(__name__).warning(
+                "ClusterService REST bound to %s: control POSTs are "
+                "network-writable; generated auth token %s (clients must "
+                "send it as X-Auth-Token)", host, auth_token,
+            )
+        self.auth_token = auth_token
 
         from deeplearning4j_tpu.utils.httpjson import (
             QuietHandler,
@@ -146,6 +171,12 @@ class ClusterService:
                 self._json(200, payload)
 
             def do_POST(self):  # noqa: N802
+                if service.auth_token is not None and not hmac.compare_digest(
+                    self.headers.get("X-Auth-Token") or "",
+                    service.auth_token,
+                ):
+                    return self._json(401, {"error": "bad or missing "
+                                            "X-Auth-Token"})
                 parts = self.path.strip("/").split("/")
                 req = read_json_body(self)
                 if req is None:

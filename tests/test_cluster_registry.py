@@ -142,6 +142,41 @@ def test_registry_lock_mutual_exclusion_and_lease_expiry():
         server.stop()
 
 
+def test_statetracker_rest_auth_token():
+    """Control POSTs require X-Auth-Token when a token is configured
+    (ADVICE r3: non-loopback binds expose mutation endpoints)."""
+    import urllib.error
+
+    from deeplearning4j_tpu.parallel.cluster import ClusterService
+
+    svc = ClusterService()
+    svc.minibatch = 32
+    port = svc.start_rest_api(0, auth_token="sekrit")
+    base = f"http://127.0.0.1:{port}/statetracker"
+    try:
+        # GET stays open (read-only status)
+        assert _get(f"{base}/minibatch") == 32
+        # POST without token -> 401, state unchanged
+        try:
+            _post(f"{base}/minibatch", {"value": 64})
+            raise AssertionError("expected 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        assert svc.minibatch == 32
+        # POST with token succeeds
+        req = urllib.request.Request(
+            f"{base}/minibatch", data=json.dumps({"value": 64}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Auth-Token": "sekrit"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read()) == {"minibatch": 64}
+        assert svc.minibatch == 64
+    finally:
+        svc.stop_rest_api()
+
+
 def test_statetracker_rest_post_control():
     from deeplearning4j_tpu.parallel.cluster import ClusterService
 

@@ -109,6 +109,34 @@ def test_inverted_index():
     assert idx.document(1) == ["b", "c"]
 
 
+def test_inverted_index_persistence_labels_minibatches(tmp_path):
+    """npz save/load round-trip + labels + sampled mini-batches
+    (≙ LuceneInvertedIndex persistence :910, miniBatches/sample,
+    documentWithLabels)."""
+    idx = InvertedIndex(sample=0.0)
+    idx.add_document(["a", "b"], labels=["pos"])
+    idx.add_document(["b", "c"])
+    idx.add_label_for_doc(1, "neg")
+    idx.add_word_to_doc(1, "d")
+    assert idx.document_with_labels(0) == (["a", "b"], ["pos"])
+    assert idx.documents("d") == [1]
+
+    path = str(tmp_path / "index.npz")
+    idx.save(path)
+    loaded = InvertedIndex.load(path)
+    assert loaded.num_documents() == 2
+    assert loaded.all_docs() == idx.all_docs()
+    assert loaded.document_with_labels(1) == (["b", "c", "d"], ["neg"])
+    assert loaded.documents("b") == [0, 1]
+
+    # sample=0 -> every doc appears exactly once across mini-batches
+    batches = list(loaded.mini_batches(1))
+    assert [b[0] for b in batches] == loaded.all_docs()
+    # sample<1 keeps a subset
+    loaded.sample = 1e-9
+    assert list(loaded.mini_batches(2, seed=1)) == []
+
+
 def test_bow_and_tfidf():
     texts = ["the cat sat", "the dog sat", "the cat ran"]
     bow = BagOfWordsVectorizer().fit(texts)

@@ -92,6 +92,51 @@ def test_flash_attention_trainable_causal_grads_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+def _dense_decode_ref(q, kvcache, pos, n_kv_heads, layer):
+    """Dense einsum oracle for one decode step against the packed cache."""
+    b, g, hk = q.shape
+    hd = hk // n_kv_heads
+    kk = np.asarray(kvcache[layer, 0], np.float32)  # (B, T, hk)
+    vv = np.asarray(kvcache[layer, 1], np.float32)
+    t = kk.shape[1]
+    qr = np.asarray(q, np.float32).reshape(b, g, n_kv_heads, hd)
+    kr = kk.reshape(b, t, n_kv_heads, hd)
+    vr = vv.reshape(b, t, n_kv_heads, hd)
+    s = np.einsum("bghd,bthd->bght", qr, kr) / np.sqrt(hd)
+    s[..., pos + 1:] = -np.inf
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bght,bthd->bghd", p, vr).reshape(b, g, hk)
+
+
+def test_flash_decode_attention_matches_dense():
+    """Direct interpret-mode gate on the decode kernel (GQA packing,
+    pos masking at cache-padding rows, multi-block streaming) — the
+    generate/decode parity tests exercise it only indirectly and mostly
+    in the slow lane."""
+    from deeplearning4j_tpu.ops.pallas_kernels import flash_decode_attention
+
+    rng = np.random.default_rng(11)
+    for b, g, n_kv, t, pos, layer in [
+        (2, 1, 2, 32, 0, 0),       # pos at the first row (MHA)
+        (2, 1, 2, 32, 31, 0),      # pos at the last valid row
+        (1, 4, 2, 32, 13, 1),      # GQA groups, padded cache, layer 1
+        (2, 2, 3, 24, 7, 0),       # non-pow2 head count, padding
+    ]:
+        hk = n_kv * 16
+        n_layers = 2
+        q = jnp.asarray(rng.normal(size=(b, g, hk)).astype(np.float32))
+        cache = jnp.asarray(
+            rng.normal(size=(n_layers, 2, b, t, hk)).astype(np.float32)
+        )
+        out = flash_decode_attention(
+            q, cache, jnp.int32(pos), n_kv, layer=layer, block_t=8,
+            interpret=True,
+        )
+        ref = _dense_decode_ref(q, cache, pos, n_kv, layer)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
 def test_flash_attention_noncausal_unchanged():
     from deeplearning4j_tpu.ops.attention import attention
     from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
