@@ -56,6 +56,10 @@ class InvertedIndex:
             bisect.insort(posting, doc_id)
 
     def add_label_for_doc(self, doc_id: int, label: str) -> None:
+        if not label or "\x00" in label:
+            # empty labels vanish and NUL collides with the persistence
+            # separator — reject instead of silently corrupting round-trips
+            raise ValueError(f"invalid label {label!r}")
         self._labels.setdefault(doc_id, [])
         if label not in self._labels[doc_id]:
             self._labels[doc_id].append(label)
@@ -116,13 +120,16 @@ class InvertedIndex:
             tokens.extend(doc)
             doc_offsets[i + 1] = len(tokens)
         label_ids = sorted(self._labels)
+        # unicode dtype (not object) so load never needs allow_pickle —
+        # a pickled npz from an untrusted path would be code execution
         np.savez_compressed(
             path,
-            tokens=np.asarray(tokens, dtype=object),
+            tokens=np.asarray(tokens, dtype=np.str_),
             doc_offsets=doc_offsets,
             label_doc_ids=np.asarray(label_ids, dtype=np.int64),
             label_values=np.asarray(
-                ["\x00".join(self._labels[i]) for i in label_ids], dtype=object
+                ["\x00".join(self._labels[i]) for i in label_ids],
+                dtype=np.str_,
             ),
             sample=np.float64(self.sample),
         )
@@ -131,7 +138,7 @@ class InvertedIndex:
     def load(cls, path: str) -> "InvertedIndex":
         if not path.endswith(".npz"):
             path += ".npz"
-        with np.load(path, allow_pickle=True) as z:
+        with np.load(path) as z:
             tokens = z["tokens"].tolist()
             offsets = z["doc_offsets"]
             idx = cls(sample=float(z["sample"]))

@@ -1,0 +1,153 @@
+"""Real-data parity gates consuming the reference's read-only test
+fixtures (data-only use of the /root/reference mount — no code).
+
+Every reference acceptance test runs on real data; these gates do the
+same with the files that physically ship in the reference tree:
+
+- ``iris.dat``                  ≙ MultiLayerTest.java:79-116 (DBN/MLP on Iris)
+- ``big/raw_sentences.txt``     ≙ Word2VecTests.java (similarity bound on a
+                                  real corpus; the 97k-sentence fixture)
+- ``vec.bin`` / ``vec.txt``     ≙ WordVectorSerializer.loadGoogleModel:42
+                                  (real Google-format files, both codecs)
+- ``reuters/``                  ≙ the nlp text-pipeline fixtures
+- t-SNE runs on the real iris features (the reference's mnist2500_X.txt
+  fixture does NOT exist in this snapshot — only mnist2500_labels.txt —
+  so the t-SNE gate uses the other real fixture)
+
+All tests skip cleanly when the reference mount is absent.
+"""
+
+import numpy as np
+import pytest
+
+REF = "/root/reference"
+NLP_RES = f"{REF}/deeplearning4j-scaleout/deeplearning4j-nlp/src/test/resources"
+CORE_RES = f"{REF}/deeplearning4j-core/src/test/resources"
+
+
+def _need(path):
+    import os
+
+    if not os.path.exists(path):
+        pytest.skip(f"reference fixture {path} not present")
+    return path
+
+
+def _load_reference_iris():
+    rows = [
+        line.strip().split(",")
+        for line in open(_need(f"{CORE_RES}/iris.dat"))
+        if line.strip()
+    ]
+    x = np.array([[float(v) for v in r[:4]] for r in rows], np.float32)
+    y = np.array([int(r[4]) for r in rows])
+    return x, y
+
+
+def test_mlp_on_reference_iris_dat():
+    """Train on the actual iris.dat the reference acceptance test uses
+    (150 rows, 3 classes) and require real learning."""
+    from deeplearning4j_tpu.datasets.base import DataSet, to_one_hot
+    from deeplearning4j_tpu.evaluation import Evaluation
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn import conf as C
+
+    x, y = _load_reference_iris()
+    assert x.shape == (150, 4) and set(np.bincount(y)) == {50}
+    ds = DataSet(x, to_one_hot(y, 3)).shuffle(123)
+    ds = ds.normalize_zero_mean_unit_variance()
+    train, test = ds.split_test_and_train(110)
+    base = C.LayerConfig(
+        activation="tanh", lr=0.1, num_iterations=200,
+        optimization_algo=C.OptimizationAlgorithm.CONJUGATE_GRADIENT,
+        use_adagrad=True, momentum=0.5, weight_init="vi",
+    )
+    mc = C.list_builder(base, sizes=[8], n_in=4, n_out=3)
+    mc.pretrain = False
+    mc.backward = True
+    net = MultiLayerNetwork(mc, seed=42)
+    net.init()
+    net.fit_dataset(train)
+    ev = Evaluation(3)
+    ev.eval(test.labels, np.asarray(net.output(test.features)))
+    assert ev.accuracy() > 0.85, ev.stats()
+
+
+def test_word2vec_real_corpus_similarity_bound():
+    """Train on the real raw_sentences.txt corpus and assert the
+    similarity("day","night") bound ≙ Word2VecTests.java — the corpus
+    where that classic assertion comes from (97k sentences; a 20k
+    subsample keeps the gate under ~15s while converging)."""
+    from deeplearning4j_tpu.models.word2vec import Word2Vec
+    from deeplearning4j_tpu.nlp.sentence_iterator import (
+        CollectionSentenceIterator,
+    )
+
+    path = _need(f"{NLP_RES}/big/raw_sentences.txt")
+    lines = [ln.strip().lower() for ln in open(path) if ln.strip()]
+    assert len(lines) > 90_000  # the real fixture, not a stub
+    sub = lines[:20_000]
+    w2v = Word2Vec(
+        layer_size=50, window=5, min_word_frequency=5, epochs=2,
+        sample=1e-3, seed=7,
+    )
+    w2v.fit(CollectionSentenceIterator(sub))
+    sim = w2v.similarity("day", "night")
+    assert sim > 0.65, sim
+    # and the bound is meaningful: an unrelated pair scores clearly lower
+    assert sim > w2v.similarity("day", "office") + 0.1
+
+
+def test_load_google_model_real_bin_and_txt():
+    """read_binary against the actual Google-format vec.bin shipped in
+    the reference (≙ WordVectorSerializer.loadGoogleModel:42), cross-
+    checked against its text twin vec.txt."""
+    from deeplearning4j_tpu.nlp.serializer import read_binary, read_text
+
+    wb, vb = read_binary(_need(f"{NLP_RES}/vec.bin"))
+    wt, vt = read_text(_need(f"{NLP_RES}/vec.txt"))
+    assert wb == wt == ["</s>", "Adam", "is", "awesome."]
+    assert vb.shape == vt.shape == (4, 100)
+    # same model, two codecs: txt rounds to 6 decimals
+    assert np.max(np.abs(vb - vt)) < 1e-5
+
+
+def test_tsne_on_reference_iris_preserves_classes():
+    """t-SNE on the real iris.dat features: the 2-D embedding keeps
+    same-class points as nearest neighbours (the reference's TsneTest
+    only smoke-runs; this asserts structure)."""
+    from deeplearning4j_tpu.plot.tsne import Tsne
+
+    x, y = _load_reference_iris()
+    emb = Tsne(perplexity=20, n_iter=300, seed=0).calculate(x)
+    assert emb.shape == (150, 2)
+    d = ((emb[:, None, :] - emb[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d, np.inf)
+    agreement = (y[d.argmin(1)] == y).mean()
+    assert agreement > 0.9, agreement
+
+
+def test_tfidf_on_real_reuters_docs():
+    """BoW/TF-IDF over the real Reuters articles in the reference tree:
+    content words outrank stop words, and a doc-specific term stays
+    specific to its document."""
+    import os
+
+    from deeplearning4j_tpu.nlp.vectorizers import TfidfVectorizer
+
+    root = _need(f"{NLP_RES}/reuters")
+    texts = []
+    for name in sorted(os.listdir(root)):
+        with open(os.path.join(root, name), errors="replace") as f:
+            texts.append(f.read().lower())
+    assert len(texts) >= 3
+    tfidf = TfidfVectorizer().fit(texts)
+    m = tfidf.transform(texts)
+    assert m.shape[0] == len(texts)
+    # 'pearson' is the subject of doc 5250 only; 'said' is everywhere
+    pearson = tfidf.cache.index_of("pearson")
+    said = tfidf.cache.index_of("said")
+    assert pearson >= 0 and said >= 0
+    assert m[0, pearson] > m[0, said]
+    # and it does not leak into the other documents
+    assert m[0, pearson] > m[1, pearson] and m[0, pearson] > m[2, pearson]
