@@ -2,16 +2,29 @@
 
 ≙ reference models/rntn/RNTN.java:55-1392: composition
 ``h = f(W [l; r; 1] + [l; r]^T V [l; r])`` bottom-up over a binary tree,
-per-node softmax sentiment classification, AdaGrad training, RNTNEval.
+per-node softmax classification, AdaGrad training, RNTNEval.
 
-TPU re-design: the reference fits trees through actor futures
-(RNTN.fit:341) with per-label ``MultiDimensionalMap`` parameter maps; here
-a single shared (W, V, Wc, embeddings) parameter set (the common Socher
-formulation — per-label maps collapse to one because binarized trees have
-one composition type) and the whole per-tree forward+backward is one
-jitted autodiff program over a *level-packed* representation: tree nodes
-are topologically ordered so composition is a ``lax.scan`` over a node
-table instead of Python recursion.
+TPU re-design, two axes:
+
+- **Per-production parameter tables.** The reference keys binary
+  transform/tensor/classification matrices by the children's syntactic
+  categories in ``MultiDimensionalMap``s (RNTN.java:94-135,372-411) —
+  but its only *runnable* mode is ``simplifiedModel`` where
+  ``basicCategory`` maps every label to ``""`` (RNTN.java:450-455; the
+  untied path throws UnsupportedOperationException at :207). Here the
+  map is a dense ``(n_productions, ...)`` leading axis + jittable
+  gather: ``simplified_model=True`` (default) reproduces the
+  one-shared-matrix behavior with ``n_productions == 1``, and
+  ``simplified_model=False`` delivers the untied capability the
+  reference declared: productions discovered from the training trees
+  (≙ the "figure out what binary productions we have" TODO at :205),
+  ``combine_classification=False`` splitting binary vs unary
+  classification matrices (≙ :245,259).
+- **Batched tree training.** The reference fits trees through actor
+  futures (RNTN.fit:341), one tree at a time; here padded node tables
+  stack into ``(batch, max_nodes)`` arrays and ``jax.vmap`` runs the
+  whole batch in ONE jitted dispatch (the per-tree scan is a
+  ``fori_loop`` over the topologically-packed node table).
 """
 
 from __future__ import annotations
@@ -27,12 +40,40 @@ from deeplearning4j_tpu.nlp.tree import Tree
 from deeplearning4j_tpu.nlp.vocab import VocabCache
 
 
+def basic_category(label: str, simplified: bool = True) -> str:
+    """≙ RNTN.basicCategory:450 — "" collapses every label (flat model);
+    the untied variant strips binarization markers (``@NP`` from the
+    binarizer) and PTB functional annotations (``NP-SBJ=2`` -> ``NP``)."""
+    if simplified:
+        return ""
+    return label.lstrip("@").split("-")[0].split("=")[0]
+
+
 def topo_pack(tree: Tree, cache: VocabCache, num_classes: int):
     """Pack a binary tree into arrays for scan execution.
 
     Returns (word_ids, left, right, is_leaf, labels) over nodes in
     topological (children-first) order.  Leaf nodes reference embedding
     rows; internal nodes reference child slots.
+    """
+    p = _pack_full(tree, cache, num_classes)
+    return p["word_ids"], p["left"], p["right"], p["leaf"], p["labels"]
+
+
+def _pack_full(
+    tree: Tree,
+    cache: VocabCache,
+    num_classes: int,
+    prod_index: dict | None = None,
+    unary_index: dict | None = None,
+    simplified: bool = True,
+):
+    """topo_pack + per-node production / unary-category indices.
+
+    ``prod`` indexes the (left-cat, right-cat) production tables for
+    internal nodes (≙ getBinaryTransform:472); ``ucat`` indexes the
+    unary classification table by the node's own category
+    (≙ getUnaryClassification:457). Unseen keys fall back to slot 0.
     """
     nodes: list[Tree] = []
 
@@ -49,21 +90,33 @@ def topo_pack(tree: Tree, cache: VocabCache, num_classes: int):
     right = np.zeros(n, np.int32)
     leaf = np.zeros(n, np.float32)
     labels = np.zeros(n, np.int32)
+    prod = np.zeros(n, np.int32)
+    ucat = np.zeros(n, np.int32)
     for i, t in enumerate(nodes):
         try:
             labels[i] = int(t.label.lstrip("@")) % num_classes
         except ValueError:
             labels[i] = 0
+        if unary_index is not None:
+            ucat[i] = unary_index.get(
+                basic_category(t.label, simplified), 0
+            )
         if t.is_leaf():
             leaf[i] = 1.0
             word_ids[i] = max(cache.index_of(t.word or ""), 0)
-        elif len(t.children) == 1:
-            leaf[i] = 0.0
-            left[i] = right[i] = index[id(t.children[0])]
         else:
             left[i] = index[id(t.children[0])]
-            right[i] = index[id(t.children[1])]
-    return word_ids, left, right, leaf, labels
+            right[i] = index[id(t.children[-1])]
+            if prod_index is not None:
+                key = (
+                    basic_category(t.children[0].label, simplified),
+                    basic_category(t.children[-1].label, simplified),
+                )
+                prod[i] = prod_index.get(key, 0)
+    return dict(
+        word_ids=word_ids, left=left, right=right, leaf=leaf,
+        labels=labels, prod=prod, ucat=ucat,
+    )
 
 
 class RNTN:
@@ -75,6 +128,9 @@ class RNTN:
         use_tensor: bool = True,
         seed: int = 123,
         max_nodes: int = 64,
+        simplified_model: bool = True,
+        combine_classification: bool = True,
+        batch_size: int = 8,
     ):
         self.num_classes = num_classes
         self.dim = dim
@@ -82,25 +138,101 @@ class RNTN:
         self.use_tensor = use_tensor
         self.seed = seed
         self.max_nodes = max_nodes
+        self.simplified_model = simplified_model
+        self.combine_classification = combine_classification
+        self.batch_size = batch_size
         self.cache = VocabCache()
         self.params = None
         self._adagrad = None
+        # production / unary-category registries (slot 0 = fallback);
+        # simplified mode keeps exactly the one ("","") production the
+        # reference seeds at RNTN.java:202
+        self.prod_index: dict[tuple[str, str], int] = {("", ""): 0}
+        self.unary_index: dict[str, int] = {"": 0}
+
+    # -- production discovery ----------------------------------------------
+    def discover_productions(self, trees: Iterable[Tree]) -> None:
+        """≙ the binaryProductions/unaryProductions discovery the
+        reference left as a TODO (RNTN.java:205-219). No-op in
+        simplified mode (everything is the "" category)."""
+        if self.simplified_model:
+            return
+        for t in trees:
+            for node in t.subtrees():
+                cat = basic_category(node.label, False)
+                if cat not in self.unary_index:
+                    self.unary_index[cat] = len(self.unary_index)
+                if node.children:
+                    key = (
+                        basic_category(node.children[0].label, False),
+                        basic_category(node.children[-1].label, False),
+                    )
+                    if key not in self.prod_index:
+                        self.prod_index[key] = len(self.prod_index)
 
     def init_params(self) -> None:
         d, c, v = self.dim, self.num_classes, max(len(self.cache), 1)
-        k = jax.random.split(jax.random.key(self.seed), 4)
+        np_, nu = len(self.prod_index), len(self.unary_index)
+        k = jax.random.split(jax.random.key(self.seed), 6)
         r = 1.0 / np.sqrt(2 * d)
         self.params = {
-            "W": jax.random.uniform(k[0], (d, 2 * d + 1), minval=-r, maxval=r),
-            "V": jax.random.uniform(k[1], (2 * d, 2 * d, d), minval=-r, maxval=r)
+            # leading production axis ≙ binaryTransform / binaryTensors
+            # MultiDimensionalMaps (RNTN.java:94-101); n_prod==1 in
+            # simplified mode = the reference's flat model
+            "W": jax.random.uniform(
+                k[0], (np_, d, 2 * d + 1), minval=-r, maxval=r
+            ),
+            "V": jax.random.uniform(
+                k[1], (np_, 2 * d, 2 * d, d), minval=-r, maxval=r
+            )
             * (1.0 if self.use_tensor else 0.0),
             "Wc": jax.random.uniform(k[2], (c, d + 1), minval=-r, maxval=r),
             "emb": 0.1 * jax.random.normal(k[3], (v, d)),
         }
+        if not self.combine_classification:
+            # ≙ binaryClassification (:251) + unaryClassification (:259)
+            self.params["Wc_bin"] = jax.random.uniform(
+                k[4], (np_, c, d + 1), minval=-r, maxval=r
+            )
+            self.params["Wc_un"] = jax.random.uniform(
+                k[5], (nu, c, d + 1), minval=-r, maxval=r
+            )
         self._adagrad = jax.tree.map(jnp.zeros_like, self.params)
 
-    # -- forward over the packed tree (scan) -------------------------------
-    def _tree_loss(self, params, word_ids, left, right, leaf, labels, node_mask):
+    def _grow_tables(self) -> None:
+        """Extend the production/unary-keyed tables to the registry
+        sizes, preserving trained slots; new slots init like
+        init_params and start with fresh AdaGrad history."""
+        d, c = self.dim, self.num_classes
+        targets = {
+            "W": (len(self.prod_index), (d, 2 * d + 1)),
+            "V": (len(self.prod_index), (2 * d, 2 * d, d)),
+        }
+        if not self.combine_classification:
+            targets["Wc_bin"] = (len(self.prod_index), (c, d + 1))
+            targets["Wc_un"] = (len(self.unary_index), (c, d + 1))
+        r = 1.0 / np.sqrt(2 * d)
+        key = jax.random.key(self.seed + 1)
+        for name, (n_new, shape) in targets.items():
+            cur = self.params[name]
+            if cur.shape[0] >= n_new:
+                continue
+            key, sub = jax.random.split(key)
+            fresh = jax.random.uniform(
+                sub, (n_new - cur.shape[0], *shape), minval=-r, maxval=r
+            )
+            if name == "V" and not self.use_tensor:
+                fresh = fresh * 0.0
+            self.params[name] = jnp.concatenate([cur, fresh])
+            self._adagrad[name] = jnp.concatenate(
+                [self._adagrad[name], jnp.zeros_like(fresh)]
+            )
+
+    # -- forward over the packed tree (fori_loop) ---------------------------
+    def _tree_loss(
+        self, params, word_ids, left, right, leaf, labels, node_mask,
+        prod, ucat,
+    ):
         d = self.dim
         n = word_ids.shape[0]
         vecs0 = jnp.zeros((n, d))
@@ -109,77 +241,152 @@ class RNTN:
             l = vecs[left[i]]
             r_vec = vecs[right[i]]
             lr_cat = jnp.concatenate([l, r_vec, jnp.ones(1)])
-            linear = params["W"] @ lr_cat
+            linear = params["W"][prod[i]] @ lr_cat
             lr2 = jnp.concatenate([l, r_vec])
-            tensor = jnp.einsum("a,abd,b->d", lr2, params["V"], lr2)
+            tensor = jnp.einsum("a,abd,b->d", lr2, params["V"][prod[i]], lr2)
             composed = jnp.tanh(linear + tensor)
             leaf_vec = jnp.tanh(params["emb"][word_ids[i]])
             vec = jnp.where(leaf[i] > 0, leaf_vec, composed)
             return vecs.at[i].set(vec)
 
         vecs = jax.lax.fori_loop(0, n, body, vecs0)
-        logits = vecs @ params["Wc"][:, :d].T + params["Wc"][:, d]
+        logits = self._node_logits(params, vecs, leaf, prod, ucat)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -logp[jnp.arange(n), labels] * node_mask
         return jnp.sum(nll) / jnp.maximum(jnp.sum(node_mask), 1.0), vecs
 
+    def _node_logits(self, params, vecs, leaf, prod, ucat):
+        d = self.dim
+        if self.combine_classification:
+            wc = params["Wc"]
+            return vecs @ wc[:, :d].T + wc[:, d]
+        # untied classification: binary nodes read the production table,
+        # leaf/unary nodes the category table (≙ getClassWForNode:400)
+        wsel = jnp.where(
+            (leaf > 0)[:, None, None],
+            params["Wc_un"][ucat],
+            params["Wc_bin"][prod],
+        )  # (n, c, d+1)
+        return jnp.einsum("nd,ncd->nc", vecs, wsel[:, :, :d]) + wsel[:, :, d]
+
     @functools.partial(jax.jit, static_argnames=("self",))
-    def _step(self, params, hist, word_ids, left, right, leaf, labels, node_mask, lr):
-        (loss, _), grads = jax.value_and_grad(
-            lambda p: self._tree_loss(p, word_ids, left, right, leaf, labels, node_mask),
-            has_aux=True,
-        )(params)
+    def _batch_step(self, params, hist, batch, tree_w, lr):
+        """One AdaGrad step on the mean per-tree loss of a vmapped batch
+        of padded trees — B trees per dispatch instead of per actor
+        round-trip (≙ RNTN.fit:341)."""
+
+        def mean_loss(p):
+            per_tree, _ = jax.vmap(
+                lambda wi, le, ri, lf, la, ma, pr, uc: self._tree_loss(
+                    p, wi, le, ri, lf, la, ma, pr, uc
+                )
+            )(*batch)
+            return jnp.sum(per_tree * tree_w) / jnp.maximum(
+                jnp.sum(tree_w), 1.0
+            )
+
+        loss, grads = jax.value_and_grad(mean_loss)(params)
         hist = jax.tree.map(lambda h, g: h + g * g, hist, grads)
         params = jax.tree.map(
-            lambda p, g, h: p - lr * g / (jnp.sqrt(h) + 1e-8), params, grads, hist
+            lambda p, g, h: p - lr * g / (jnp.sqrt(h) + 1e-8),
+            params, grads, hist,
         )
         return params, hist, loss
 
-    def _pad(self, arrs):
+    def _pad(self, packed: dict):
         """Pad packed tree arrays to max_nodes (one compiled step shape)."""
-        word_ids, left, right, leaf, labels = arrs
-        n = len(word_ids)
+        n = len(packed["word_ids"])
         m = self.max_nodes
         if n > m:
             raise ValueError(f"tree has {n} nodes > max_nodes={m}")
         pad = m - n
-        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
-        out = [np.concatenate([a, np.zeros(pad, a.dtype)]) for a in (word_ids, left, right)]
-        leaf_p = np.concatenate([leaf, np.ones(pad, np.float32)])  # pads act as leaves
-        labels_p = np.concatenate([labels, np.zeros(pad, np.int32)])
-        return (*out, leaf_p, labels_p, mask)
+        mask = np.concatenate(
+            [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+        )
 
-    def fit_trees(self, trees: Iterable[Tree], epochs: int = 1) -> list[float]:
-        """≙ RNTN.fit:341 (actor-parallel loop -> sequential jitted steps)."""
+        def ext(a, fill=0):
+            return np.concatenate([a, np.full(pad, fill, a.dtype)])
+
+        return (
+            ext(packed["word_ids"]), ext(packed["left"]),
+            ext(packed["right"]),
+            ext(packed["leaf"], 1),  # pads act as leaves
+            ext(packed["labels"]), mask,
+            ext(packed["prod"]), ext(packed["ucat"]),
+        )
+
+    def _pack_padded(self, tree: Tree):
+        return self._pad(
+            _pack_full(
+                tree, self.cache, self.num_classes,
+                self.prod_index, self.unary_index, self.simplified_model,
+            )
+        )
+
+    def fit_trees(
+        self, trees: Iterable[Tree], epochs: int = 1,
+        batch_size: int | None = None,
+    ) -> list[float]:
+        """≙ RNTN.fit:341 (actor-parallel loop -> vmapped jitted batches)."""
         trees = list(trees)
         if len(self.cache) == 0:
             self.cache.fit([t.words() for t in trees])
+        self.discover_productions(trees)
         if self.params is None:
             self.init_params()
+        else:
+            # a later fit may register new productions/categories — the
+            # tables must grow with the registries (a stale table would
+            # silently clamp the new indices onto the last slot in jit)
+            self._grow_tables()
+        b = max(1, min(batch_size or self.batch_size, len(trees)))
+        # pack once, train many epochs (trees are static); the last
+        # batch is padded to the same B with zero-weight repeats so one
+        # compiled step shape covers the whole run
+        packed = [self._pack_padded(t) for t in trees]
+        n = len(trees)
+        pad = (-n) % b
+        cols = [
+            jnp.asarray(np.stack(col + col[:1] * pad))
+            for col in (list(z) for z in zip(*packed))
+        ]
+        weights = np.concatenate(
+            [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+        )
         losses = []
         for _ in range(epochs):
-            total = 0.0
-            for t in trees:
-                packed = self._pad(topo_pack(t, self.cache, self.num_classes))
-                args = [jnp.asarray(a) for a in packed]
-                self.params, self._adagrad, loss = self._step(
-                    self.params, self._adagrad, *args, jnp.float32(self.lr)
+            total, nw = 0.0, 0.0
+            for s in range(0, n + pad, b):
+                batch = tuple(c[s:s + b] for c in cols)
+                w = jnp.asarray(weights[s:s + b])
+                self.params, self._adagrad, loss = self._batch_step(
+                    self.params, self._adagrad, batch, w,
+                    jnp.float32(self.lr),
                 )
-                total += float(loss)
-            losses.append(total / max(len(trees), 1))
+                bw = float(weights[s:s + b].sum())
+                total += float(loss) * bw
+                nw += bw
+            losses.append(total / max(nw, 1.0))
         return losses
 
     def predict_root(self, tree: Tree) -> int:
-        packed = self._pad(topo_pack(tree, self.cache, self.num_classes))
-        word_ids, left, right, leaf, labels, mask = (jnp.asarray(a) for a in packed)
-        _, vecs = self._tree_loss(
-            self.params, word_ids, left, right, leaf, labels, mask
+        # the root is the last real node in topological order
+        return int(self.predict_nodes(tree)[-1])
+
+    def predict_nodes(self, tree: Tree) -> np.ndarray:
+        """Per-node class predictions in topological order (real nodes
+        only) — the node-level view RNTNEval.java:61 accumulates."""
+        padded = self._pack_padded(tree)
+        word_ids, left, right, leaf, labels, mask, prod, ucat = (
+            jnp.asarray(a) for a in padded
         )
+        _, vecs = self._tree_loss(
+            self.params, word_ids, left, right, leaf, labels, mask,
+            prod, ucat,
+        )
+        logits = self._node_logits(self.params, vecs, leaf, prod, ucat)
         n_real = int(mask.sum())
-        root_vec = vecs[n_real - 1]
-        d = self.dim
-        logits = self.params["Wc"][:, :d] @ root_vec + self.params["Wc"][:, d]
-        return int(jnp.argmax(logits))
+        return np.asarray(jnp.argmax(logits[:n_real], axis=-1))
 
 
 class RNTNEval:

@@ -232,6 +232,84 @@ def test_paragraph_vectors_dbow():
     assert pv.infer_nearest_label("moon stars dark night") == "nighttime"
 
 
+@pytest.mark.slow
+def test_paragraph_vectors_negative_sampling():
+    """PV-DBOW through the negative-sampling kernel (≙ iterateSample's
+    negative branch, InMemoryLookupTable.java:217-243, reached via the
+    inherited ParagraphVectors path): same-topic label vectors cluster,
+    cross-topic ones don't."""
+    rng = np.random.default_rng(0)
+    topics = [
+        ["day", "sun", "light", "bright"],
+        ["night", "moon", "dark", "stars"],
+        ["cat", "dog", "pet", "fur"],
+        ["car", "road", "drive", "wheel"],
+    ]
+    fillers = [f"w{k}" for k in range(200)]
+    docs = []
+    for i in range(1000):
+        words = list(rng.choice(topics[i % 4], 5)) + list(
+            rng.choice(fillers, 5)
+        )
+        rng.shuffle(words)
+        docs.append((f"doc{i}", " ".join(words)))
+    pv = ParagraphVectors(
+        layer_size=32, epochs=8, lr=0.05, seed=6, train_words=False,
+        use_hierarchical_softmax=False, negative=5,
+    )
+    pv.fit_labeled(docs)
+    vecs = np.stack([pv.get_label_vector(f"doc{i}") for i in range(120)])
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True) + 1e-9
+    sims = vecs @ vecs.T
+    same = np.mean(
+        [sims[i, j] for i in range(120) for j in range(120)
+         if i != j and i % 4 == j % 4]
+    )
+    cross = np.mean(
+        [sims[i, j] for i in range(120) for j in range(120) if i % 4 != j % 4]
+    )
+    assert same > cross + 0.3, (same, cross)
+
+
+def test_paragraph_vectors_freezes_words_and_scratch_padding():
+    """train_words=False must leave word vectors untouched even when the
+    pair stream is not a whole number of batches (the padded tail rides
+    on the scratch row, not word row 0)."""
+    docs = [("a", "day sun light"), ("b", "night moon dark")]
+    pv = ParagraphVectors(
+        layer_size=8, epochs=3, lr=0.1, seed=2, train_words=False,
+    )
+    from deeplearning4j_tpu.nlp.sentence_iterator import (
+        CollectionSentenceIterator,
+    )
+
+    pv.build_vocab(CollectionSentenceIterator([s for _, s in docs]))
+    pv.reset_weights()
+    syn0_before = np.asarray(pv.syn0).copy()
+    pv.fit_labeled(docs)
+    np.testing.assert_array_equal(np.asarray(pv.syn0), syn0_before)
+    assert pv.syn0_labels.shape == (2, 8)
+
+
+def test_rntn_refit_grows_per_label_tables():
+    """A later fit with unseen productions must grow the tables, not
+    silently clamp the new indices onto the last slot."""
+    from deeplearning4j_tpu.models.rntn import RNTN
+    from deeplearning4j_tpu.nlp.tree import parse_ptb
+
+    m = RNTN(
+        num_classes=2, dim=4, seed=0, max_nodes=16,
+        simplified_model=False, combine_classification=False,
+    )
+    m.fit_trees([parse_ptb("(S (A a) (B b))")], epochs=1)
+    n1 = m.params["W"].shape[0]
+    m.fit_trees([parse_ptb("(S (C c) (D d))")], epochs=1)
+    assert len(m.prod_index) > n1
+    assert m.params["W"].shape[0] == len(m.prod_index)
+    assert m.params["Wc_un"].shape[0] == len(m.unary_index)
+    assert m._adagrad["W"].shape == m.params["W"].shape
+
+
 def test_vocab_fit_texts_native_matches_fit():
     """fit_texts (native tokenizer+counter) == fit over the same tokens."""
     from deeplearning4j_tpu.nlp.vocab import VocabCache

@@ -87,6 +87,58 @@ def test_rntn_learns_sentiment():
     assert ev.accuracy() > 0.85, ev.accuracy()
 
 
+@pytest.mark.slow
+def test_rntn_per_label_tables_on_treebank():
+    """Untied per-production parameter tables (≙ RNTN.java:94-135
+    MultiDimensionalMaps — the capability the reference declares but
+    only runs in flat simplifiedModel mode): productions discovered
+    from nlp/parser.py's bundled treebank, label-indexed W/V/Wc_bin/
+    Wc_un exercised via gather, node-category classification learned
+    to high accuracy."""
+    import copy
+
+    from deeplearning4j_tpu.models.rntn import _pack_full, basic_category
+    from deeplearning4j_tpu.nlp.parser import bundled_treebank
+
+    trees = [binarize(t) for t in bundled_treebank()]
+    cats = sorted(
+        {basic_category(n.label, False) for t in trees for n in t.subtrees()}
+    )
+    cat_id = {c: i for i, c in enumerate(cats)}
+    assert len(cats) >= 10  # NP/VP/PP/S + POS tags — real category variety
+
+    def relabel(t):
+        cat = basic_category(t.label, False)
+        for c in t.children:
+            relabel(c)
+        t.label = str(cat_id[cat])
+
+    relabeled = [copy.deepcopy(t) for t in trees]
+    for t in relabeled:
+        relabel(t)
+
+    model = RNTN(
+        num_classes=len(cats), dim=12, lr=0.1, seed=3, max_nodes=32,
+        simplified_model=False, combine_classification=False, batch_size=10,
+    )
+    losses = model.fit_trees(relabeled, epochs=20)
+    # the untied tables are real: one slot per discovered production
+    assert len(model.prod_index) > 5
+    assert model.params["W"].shape[0] == len(model.prod_index)
+    assert model.params["Wc_un"].shape[0] == len(model.unary_index)
+    assert losses[-1] < losses[0] / 10
+    correct = total = 0
+    for t in relabeled:
+        gold = _pack_full(
+            t, model.cache, model.num_classes, model.prod_index,
+            model.unary_index, False,
+        )["labels"]
+        pred = model.predict_nodes(t)
+        correct += int((pred == gold).sum())
+        total += len(gold)
+    assert correct / total > 0.9, correct / total
+
+
 def test_viterbi_decodes_obvious_path():
     # two states; state 0 emits obs 0, state 1 emits obs 1
     trans = np.array([[0.8, 0.2], [0.2, 0.8]])
