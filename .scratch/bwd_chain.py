@@ -1,0 +1,111 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import deeplearning4j_tpu.ops.pallas_kernels as PK
+
+B,H,T,D = 2,8,8192,64
+bh=B*H
+rng=np.random.default_rng(0)
+QF,KF,VF,DO = (jnp.asarray(rng.normal(size=(bh,T,D)).astype(np.float32)).astype(jnp.bfloat16) for _ in range(4))
+# realistic lse/delta: from the actual forward so p<=~1
+out, LSE = PK._flash_fwd_call(QF,KF,VF,1024,1024,False,True)
+DELTA = jnp.sum(DO.astype(jnp.float32)*out.astype(jnp.float32),axis=-1)[...,None]
+log2e = 1.4426950408889634
+
+def make_bwd(variant, BQ, BK):
+    n_q=T//BQ; n_k=T//BK
+    scale=1.0/(D**0.5)
+    exp2 = "exp2" in variant
+    bf16ds = "bf16ds" in variant
+    def kernel(q_ref,k_ref,v_ref,do_ref,lse_ref,delta_ref,dq_ref,dk_ref,dv_ref,dk_s,dv_s):
+        kk=pl.program_id(1); qq=pl.program_id(2)
+        k_start=kk*BK; q_start=qq*BQ
+        @pl.when(qq==0)
+        def _i():
+            dk_s[:]=jnp.zeros_like(dk_s); dv_s[:]=jnp.zeros_like(dv_s)
+        def compute(masked):
+            k_blk=k_ref[0]; v_blk=v_ref[0]
+            qs = scale*log2e if exp2 else scale
+            q=q_ref[0]*jnp.asarray(qs,q_ref.dtype)
+            do_=do_ref[0]; l_=lse_ref[0,:,0]; dl=delta_ref[0,:,0]
+            s=jnp.dot(q,k_blk.T,preferred_element_type=jnp.float32)
+            if masked:
+                s=s+PK._causal_bias(q_start,k_start,BQ,BK)
+            p=jnp.exp2(s-l_[:,None]) if exp2 else jnp.exp(s-l_[:,None])
+            dv_s[:]=dv_s[:]+jnp.dot(p.astype(do_.dtype).T,do_,preferred_element_type=jnp.float32)
+            dp=jnp.dot(do_,v_blk.T,preferred_element_type=jnp.float32)
+            if bf16ds:
+                ds=p.astype(q_ref.dtype)*(dp-dl[:,None]).astype(q_ref.dtype)
+            else:
+                ds=(p*(dp-dl[:,None])).astype(q_ref.dtype)
+            if exp2:
+                # q was scaled by scale*log2e; dk must use scale only
+                dk_s[:]=dk_s[:]+jnp.dot(ds.T,q,preferred_element_type=jnp.float32)*jnp.float32(1.0/log2e)
+            else:
+                dk_s[:]=dk_s[:]+jnp.dot(ds.T,q,preferred_element_type=jnp.float32)
+            dq_c=jnp.dot(ds,k_blk,preferred_element_type=jnp.float32)*scale
+            @pl.when(kk==0)
+            def _a(): dq_ref[0]=dq_c
+            @pl.when(kk!=0)
+            def _b(): dq_ref[0]=dq_ref[0]+dq_c
+        PK._causal_dispatch(compute,True,q_start,k_start,BQ,BK)
+        @pl.when(qq==n_q-1)
+        def _f():
+            dk_ref[0]=dk_s[:].astype(dk_ref.dtype); dv_ref[0]=dv_s[:].astype(dv_ref.dtype)
+    def call(q,k,v,do,lse,delta):
+        return pl.pallas_call(kernel,
+            out_shape=(jax.ShapeDtypeStruct((bh,T,D),jnp.float32),
+                       jax.ShapeDtypeStruct((bh,T,D),k.dtype),
+                       jax.ShapeDtypeStruct((bh,T,D),v.dtype)),
+            grid=(bh,n_k,n_q),
+            in_specs=[pl.BlockSpec((1,BQ,D),lambda i,j,qq:(i,qq,0)),
+                      pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0)),
+                      pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0)),
+                      pl.BlockSpec((1,BQ,D),lambda i,j,qq:(i,qq,0)),
+                      pl.BlockSpec((1,BQ,1),lambda i,j,qq:(i,qq,0)),
+                      pl.BlockSpec((1,BQ,1),lambda i,j,qq:(i,qq,0))],
+            out_specs=(pl.BlockSpec((1,BQ,D),lambda i,j,qq:(i,qq,0)),
+                       pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0)),
+                       pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0))),
+            scratch_shapes=[pltpu.VMEM((BK,D),jnp.float32),pltpu.VMEM((BK,D),jnp.float32)],
+            compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel","arbitrary","arbitrary")),
+            interpret=False)(q,k,v,do,lse,delta)
+    return call
+
+N_CHAIN = 12
+def chained(variant, BQ, BK):
+    call = make_bwd(variant, BQ, BK)
+    exp2 = "exp2" in variant
+    def f(q,k,v,do,lse,delta):
+        lse2 = lse*log2e if exp2 else lse
+        dqs = jnp.zeros((), jnp.float32)
+        for i in range(N_CHAIN):
+            dq,dk,dv = call(q,k,v,do,lse2,delta)
+            # feed dq back into do (bf16) to serialize; prevents CSE
+            do = dq.astype(do.dtype)*jnp.bfloat16(1e-3) + do*jnp.bfloat16(0.999)
+            dqs = dqs + jnp.sum(dq[0,0].astype(jnp.float32))
+        return dqs
+    return jax.jit(f)
+
+def timeit(f, reps=3, windows=3):
+    x=f(QF,KF,VF,DO,LSE,DELTA); _=float(x)
+    best=1e9
+    for w in range(windows):
+        t0=time.time()
+        for _ in range(reps): x=f(QF,KF,VF,DO,LSE,DELTA)
+        _=float(x)
+        best=min(best,(time.time()-t0)/reps)
+    return best/N_CHAIN*1000
+
+if __name__=="__main__":
+    import itertools
+    cfgs = [("base",1024,1024),("exp2",1024,1024),("bf16ds",1024,1024),("exp2_bf16ds",1024,1024),
+            ("base",512,1024),("base",1024,512),("base",512,2048),("base",1024,2048),("base",2048,1024)]
+    for v,bq,bk in cfgs:
+        try:
+            ms = timeit(chained(v,bq,bk))
+            print(f"{v:12s} {bq}/{bk}: {ms:.3f} ms/kernel")
+        except Exception as e:
+            print(f"{v:12s} {bq}/{bk}: FAIL {str(e)[:80]}")

@@ -1,0 +1,97 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import deeplearning4j_tpu.ops.pallas_kernels as PK
+
+B,H,T,D = 2,8,8192,64
+BQ=BK=1024
+bh=B*H
+rng=np.random.default_rng(0)
+qf,kf,vf,do = (jnp.asarray(rng.normal(size=(bh,T,D)).astype(np.float32)).astype(jnp.bfloat16) for _ in range(4))
+lse = jnp.asarray(rng.normal(size=(bh,T,1)).astype(np.float32))
+delta = jnp.asarray(rng.normal(size=(bh,T,1)).astype(np.float32))
+log2e = 1.4426950408889634
+
+def make_bwd(variant, BQ=BQ, BK=BK):
+    n_q=T//BQ; n_k=T//BK
+    scale=1.0/(D**0.5)
+    def kernel(q_ref,k_ref,v_ref,do_ref,lse_ref,delta_ref,dq_ref,dk_ref,dv_ref,dk_s,dv_s):
+        kk=pl.program_id(1); qq=pl.program_id(2)
+        k_start=kk*BK; q_start=qq*BQ
+        @pl.when(qq==0)
+        def _i():
+            dk_s[:]=jnp.zeros_like(dk_s); dv_s[:]=jnp.zeros_like(dv_s)
+        def compute(masked):
+            k_blk=k_ref[0]; v_blk=v_ref[0]
+            q=q_ref[0]*jnp.asarray(scale,q_ref.dtype)
+            do_=do_ref[0]; l_=lse_ref[0,:,0]; dl=delta_ref[0,:,0]
+            s=jnp.dot(q,k_blk.T,preferred_element_type=jnp.float32)
+            if masked:
+                s=s+PK._causal_bias(q_start,k_start,BQ,BK)
+            if variant=="noexp":
+                p=(s-l_[:,None])*0.001
+            elif variant in ("exp2","exp2bf16"):
+                p=jnp.exp2(s*log2e-l_[:,None])  # lse pre-scaled by log2e outside
+            else:
+                p=jnp.exp(s-l_[:,None])
+            dv_s[:]=dv_s[:]+jnp.dot(p.astype(do_.dtype).T,do_,preferred_element_type=jnp.float32)
+            dp=jnp.dot(do_,v_blk.T,preferred_element_type=jnp.float32)
+            if variant in ("bf16ds","exp2bf16"):
+                ds=(p.astype(q.dtype)*(dp-dl[:,None]).astype(q.dtype))
+            else:
+                ds=(p*(dp-dl[:,None])).astype(q.dtype)
+            dk_s[:]=dk_s[:]+jnp.dot(ds.T,q,preferred_element_type=jnp.float32)
+            dq_c=jnp.dot(ds,k_blk,preferred_element_type=jnp.float32)*scale
+            @pl.when(kk==0)
+            def _a(): dq_ref[0]=dq_c
+            @pl.when(kk!=0)
+            def _b(): dq_ref[0]=dq_ref[0]+dq_c
+        PK._causal_dispatch(compute,True,q_start,k_start,BQ,BK)
+        @pl.when(qq==n_q-1)
+        def _f():
+            dk_ref[0]=dk_s[:].astype(dk_ref.dtype); dv_ref[0]=dv_s[:].astype(dv_ref.dtype)
+    return pl.pallas_call(kernel,
+        out_shape=(jax.ShapeDtypeStruct((bh,T,D),jnp.float32),
+                   jax.ShapeDtypeStruct((bh,T,D),kf.dtype),
+                   jax.ShapeDtypeStruct((bh,T,D),vf.dtype)),
+        grid=(bh,n_k,n_q),
+        in_specs=[pl.BlockSpec((1,BQ,D),lambda i,j,qq:(i,qq,0)),
+                  pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0)),
+                  pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0)),
+                  pl.BlockSpec((1,BQ,D),lambda i,j,qq:(i,qq,0)),
+                  pl.BlockSpec((1,BQ,1),lambda i,j,qq:(i,qq,0)),
+                  pl.BlockSpec((1,BQ,1),lambda i,j,qq:(i,qq,0))],
+        out_specs=(pl.BlockSpec((1,BQ,D),lambda i,j,qq:(i,qq,0)),
+                   pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0)),
+                   pl.BlockSpec((1,BK,D),lambda i,j,qq:(i,j,0))),
+        scratch_shapes=[pltpu.VMEM((BK,D),jnp.float32),pltpu.VMEM((BK,D),jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel","arbitrary","arbitrary")),
+        interpret=False)
+
+def timeit(fn,*a,reps=5):
+    out=fn(*a); _=float(jnp.sum(out[0]))
+    t0=time.time()
+    for _ in range(reps): out=fn(*a)
+    _=float(jnp.sum(out[0]))
+    return (time.time()-t0)/reps*1000
+
+if __name__ == "__main__":
+    for variant in ["base","exp2","bf16ds","exp2bf16","noexp"]:
+        f=jax.jit(make_bwd(variant))
+        l2 = lse*log2e if variant in ("exp2","exp2bf16") else lse
+        print(f"{variant}: {timeit(f,qf,kf,vf,do,l2,delta):.2f} ms bwd-only")
+
+def trial_matrix():
+    fns = {v: jax.jit(make_bwd(v)) for v in ["base","exp2","bf16ds","exp2bf16","noexp"]}
+    args = {v: (qf,kf,vf,do, lse*log2e if v.startswith("exp2") else lse, delta) for v in fns}
+    for v,f in fns.items(): timeit(f,*args[v],reps=2)  # warm all
+    import collections
+    res = collections.defaultdict(list)
+    for t in range(4):
+        for v,f in fns.items():
+            res[v].append(timeit(f,*args[v],reps=10))
+    for v in fns:
+        r = res[v]
+        print(f"{v}: min {min(r):.2f} ms  runs {[round(x,2) for x in r]}")

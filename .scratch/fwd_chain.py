@@ -1,0 +1,33 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import jax, jax.numpy as jnp, numpy as np
+from deeplearning4j_tpu.ops.pallas_kernels import _flash_fwd_call
+
+B,H,T,D = 2,8,8192,64
+bh=B*H
+rng=np.random.default_rng(0)
+QF,KF,VF = (jnp.asarray(rng.normal(size=(bh,T,D)).astype(np.float32)).astype(jnp.bfloat16) for _ in range(3))
+N=12
+def chained(BQ,BK):
+    def f(q,k,v):
+        acc=jnp.zeros((),jnp.float32)
+        for i in range(N):
+            o,lse = _flash_fwd_call(q,k,v,BQ,BK,False,True)
+            q = o*jnp.bfloat16(0.5)+q*jnp.bfloat16(0.5)
+            acc = acc+jnp.sum(o[0,0].astype(jnp.float32))
+        return acc
+    return jax.jit(f)
+def timeit(f,reps=3,windows=3):
+    x=f(QF,KF,VF); _=float(x)
+    best=1e9
+    for w in range(windows):
+        t0=time.time()
+        for _ in range(reps): x=f(QF,KF,VF)
+        _=float(x)
+        best=min(best,(time.time()-t0)/reps)
+    return best/N*1000
+for bq,bk in [(1024,1024),(512,1024),(512,2048),(1024,512),(256,2048),(2048,512),(512,512)]:
+    try:
+        print(f"fwd {bq}/{bk}: {timeit(chained(bq,bk)):.3f} ms/kernel")
+    except Exception as e:
+        print(f"fwd {bq}/{bk}: FAIL {str(e)[:60]}")

@@ -98,9 +98,17 @@ def _pack_full(
         except ValueError:
             labels[i] = 0
         if unary_index is not None:
-            ucat[i] = unary_index.get(
-                basic_category(t.label, simplified), 0
-            )
+            if len(t.children) == 2:
+                # binary nodes classify through the production table
+                # (getClassWForNode:400 routes two-child nodes to
+                # binaryClassification); -1 marks "not unary"
+                ucat[i] = -1
+            else:
+                # leaves AND one-child internal nodes classify by their
+                # own category (≙ getUnaryClassification:457)
+                ucat[i] = unary_index.get(
+                    basic_category(t.label, simplified), 0
+                )
         if t.is_leaf():
             leaf[i] = 1.0
             word_ids[i] = max(cache.index_of(t.word or ""), 0)
@@ -253,18 +261,20 @@ class RNTN:
         logits = self._node_logits(params, vecs, leaf, prod, ucat)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -logp[jnp.arange(n), labels] * node_mask
-        return jnp.sum(nll) / jnp.maximum(jnp.sum(node_mask), 1.0), vecs
+        loss = jnp.sum(nll) / jnp.maximum(jnp.sum(node_mask), 1.0)
+        return loss, (vecs, logits)
 
     def _node_logits(self, params, vecs, leaf, prod, ucat):
         d = self.dim
         if self.combine_classification:
             wc = params["Wc"]
             return vecs @ wc[:, :d].T + wc[:, d]
-        # untied classification: binary nodes read the production table,
-        # leaf/unary nodes the category table (≙ getClassWForNode:400)
+        # untied classification: binary nodes read the production table;
+        # leaves AND unary internal nodes read the category table —
+        # ucat == -1 marks binary nodes (≙ getClassWForNode:400)
         wsel = jnp.where(
-            (leaf > 0)[:, None, None],
-            params["Wc_un"][ucat],
+            (ucat >= 0)[:, None, None],
+            params["Wc_un"][jnp.maximum(ucat, 0)],
             params["Wc_bin"][prod],
         )  # (n, c, d+1)
         return jnp.einsum("nd,ncd->nc", vecs, wsel[:, :, :d]) + wsel[:, :, d]
@@ -380,11 +390,10 @@ class RNTN:
         word_ids, left, right, leaf, labels, mask, prod, ucat = (
             jnp.asarray(a) for a in padded
         )
-        _, vecs = self._tree_loss(
+        _, (_, logits) = self._tree_loss(
             self.params, word_ids, left, right, leaf, labels, mask,
             prod, ucat,
         )
-        logits = self._node_logits(self.params, vecs, leaf, prod, ucat)
         n_real = int(mask.sum())
         return np.asarray(jnp.argmax(logits[:n_real], axis=-1))
 

@@ -101,6 +101,12 @@ def _causal_dispatch(
         compute(True)
 
 
+# backward dq strategy: True = one bf16 partial plane per KV block,
+# summed in f32 outside the kernel (no HBM read-modify-write); False =
+# f32 rmw accumulation in the dq output block across kv revisits
+_DQ_PARTIALS = True
+
+
 def _dim_semantics(interpret, semantics=("parallel", "parallel", "arbitrary")):
     if interpret or pltpu is None:
         return None
@@ -199,6 +205,7 @@ def _flash_bwd_fused_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dk_ref, dv_ref, dk_s, dv_s,
     *, block_q: int, block_k: int, n_q: int, scale: float, causal: bool,
+    dq_partials: bool = False,
 ):
     """One (kv block, q block) step of the FUSED backward pass.
 
@@ -242,25 +249,45 @@ def _flash_bwd_fused_kernel(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        # ds in the storage dtype: cast p and (dp - delta) BEFORE the
+        # multiply instead of multiplying f32 and casting the product —
+        # one fewer full-tile f32 pass; measured part of a -4% bench win
+        # at T=8192 (r4), grad error covered by the on-device parity
+        # gate (bench._verify_flash_grads). (An exp2/log2e fold was
+        # also tried and measured neutral-to-negative in situ — exp
+        # stays.)
+        ds = p.astype(q.dtype) * (dp - delta[:, None]).astype(q.dtype)
         dk_s[:] = dk_s[:] + jnp.dot(
             ds.T, q, preferred_element_type=jnp.float32
         )
         dq_c = jnp.dot(
             ds, k_blk, preferred_element_type=jnp.float32
         ) * scale
+        if dq_partials:
+            # one clean write per (kv, q) cell into this kv block's
+            # partial plane; the caller sums planes in f32. No HBM
+            # read-modify-write at all — the non-consecutive-revisit
+            # accumulation pattern (ADVICE r3 medium) is gone.
+            dq_ref[0, 0] = dq_c.astype(dq_ref.dtype)
+        else:
+            @pl.when(kk == 0)
+            def _dq_init():
+                dq_ref[0] = dq_c
 
-        @pl.when(kk == 0)
-        def _dq_init():
-            dq_ref[0] = dq_c
+            @pl.when(kk != 0)
+            def _dq_acc():
+                dq_ref[0] = dq_ref[0] + dq_c
 
-        @pl.when(kk != 0)
-        def _dq_acc():
-            dq_ref[0] = dq_ref[0] + dq_c
-
-    # invisible tiles are skipped wholesale (their dq tile is left
-    # untouched — kv block 0, always visible, initialized it)
+    # invisible tiles are skipped wholesale (in rmw mode their dq tile
+    # is left untouched — kv block 0, always visible, initialized it;
+    # in partials mode their plane block is zeroed below)
     _causal_dispatch(compute, causal, q_start, k_start, block_q, block_k)
+    if dq_partials and causal:
+        @pl.when(
+            jnp.logical_not(_kv_block_visible(q_start, k_start, block_q))
+        )
+        def _dq_zero():
+            dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
 
     @pl.when(qq == n_q - 1)
     def _finalize():
@@ -292,14 +319,20 @@ def flash_attention(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
-def _flash_bhtd(qf, kf, vf, block_q, block_k, interpret, causal):
+def _flash_bhtd(
+    qf, kf, vf, block_q, block_k, interpret, causal,
+    bwd_block_q=None, bwd_block_k=None,
+):
     out, _ = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal)
     return out
 
 
-def _flash_fwd_rule(qf, kf, vf, block_q, block_k, interpret, causal):
+def _flash_fwd_rule(
+    qf, kf, vf, block_q, block_k, interpret, causal,
+    bwd_block_q=None, bwd_block_k=None,
+):
     out, lse = _flash_fwd_call(qf, kf, vf, block_q, block_k, interpret, causal)
     # name the residuals so a surrounding jax.checkpoint policy can mark
     # them saveable: without this, rematerialization re-runs the whole
@@ -313,10 +346,16 @@ def _flash_fwd_rule(qf, kf, vf, block_q, block_k, interpret, causal):
     return out, (qf, kf, vf, out, lse)
 
 
-def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
+def _flash_bwd_rule(
+    block_q, block_k, interpret, causal, bwd_block_q, bwd_block_k, res, do
+):
     qf, kf, vf, out, lse = res
     bh, t, d = qf.shape
     scale = 1.0 / (d**0.5)
+    # the backward's compute/DMA balance differs from the forward's (5
+    # dots + an f32 rmw dq tile vs 2 dots): it gets its own block shape
+    block_q = bwd_block_q or block_q
+    block_k = bwd_block_k or block_k
     n_q, n_k = t // block_q, t // block_k
     # delta_i = <dO_i, O_i> — the softmax normalizer correction; kept
     # (bh, t, 1) for the same Mosaic block-shape rule as lse
@@ -324,16 +363,30 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[..., None]
 
-    dq32, dk, dv = pl.pallas_call(
+    # partials memory scales with n_k (one bf16 plane per KV block):
+    # fine at the model's tuned blocks (n_k <= 8) but a 32x HBM blowup
+    # for a caller using the public default block_k=128 at long T —
+    # those fall back to the rmw accumulation path
+    dq_partials = _DQ_PARTIALS and n_k <= 8
+    if dq_partials:
+        dq_shape = jax.ShapeDtypeStruct((n_k, bh, t, d), qf.dtype)
+        dq_spec = pl.BlockSpec(
+            (1, 1, block_q, d), lambda i, j, qq: (j, i, qq, 0)
+        )
+    else:
+        # dq accumulates across kv blocks in its HBM tile: f32 so
+        # repeated read-modify-writes don't round at bf16 (cast once
+        # below, matching the old scratch-accumulator precision)
+        dq_shape = jax.ShapeDtypeStruct((bh, t, d), jnp.float32)
+        dq_spec = pl.BlockSpec((1, block_q, d), lambda i, j, qq: (i, qq, 0))
+    dq_raw, dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_fused_kernel, block_q=block_q, block_k=block_k,
             n_q=n_q, scale=scale, causal=causal,
+            dq_partials=dq_partials,
         ),
         out_shape=(
-            # dq accumulates across kv blocks in its HBM tile: f32 so
-            # repeated read-modify-writes don't round at bf16 (cast once
-            # below, matching the old scratch-accumulator precision)
-            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+            dq_shape,
             jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
             jax.ShapeDtypeStruct((bh, t, d), vf.dtype),
         ),
@@ -347,7 +400,7 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
             pl.BlockSpec((1, block_q, 1), lambda i, j, qq: (i, qq, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda i, j, qq: (i, qq, 0)),
+            dq_spec,
             pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0)),
         ),
@@ -355,15 +408,18 @@ def _flash_bwd_rule(block_q, block_k, interpret, causal, res, do):
             _vmem((block_k, d), jnp.float32),
             _vmem((block_k, d), jnp.float32),
         ],
-        # the kv dim must be SEQUENTIAL (not "parallel"): dq tiles are
-        # revisited and accumulated across it — a megacore split over
-        # kv (v4/v5p) would race the read-modify-writes
+        # the kv dim must be SEQUENTIAL (not "parallel") in rmw mode:
+        # dq tiles are revisited and accumulated across it — a megacore
+        # split over kv (v4/v5p) would race the read-modify-writes
         compiler_params=_dim_semantics(
             interpret, ("parallel", "arbitrary", "arbitrary")
         ),
         interpret=interpret,
     )(qf, kf, vf, do, lse, delta)
-    return dq32.astype(qf.dtype), dk, dv
+    if dq_partials:
+        dq = jnp.sum(dq_raw.astype(jnp.float32), axis=0).astype(qf.dtype)
+        return dq, dk, dv
+    return dq_raw.astype(qf.dtype), dk, dv
 
 
 _flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -378,6 +434,8 @@ def flash_attention_trainable(
     interpret: bool | None = None,
     causal: bool = False,
     layout: str = "bthd",
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
 ) -> jax.Array:
     """Differentiable flash attention: (B, T, H, D) in and out
     (``layout="bhtd"``: (B, H, T, D) in and out — a free reshape into
@@ -402,7 +460,16 @@ def flash_attention_trainable(
         qf, kf, vf = (
             a.transpose(0, 2, 1, 3).reshape(b * h, t, d) for a in (q, k, v)
         )
-    out = _flash_bhtd(qf, kf, vf, block_q, block_k, interpret, causal)
+    if bwd_block_q is not None:
+        bwd_block_q = min(bwd_block_q, t)
+        assert t % bwd_block_q == 0
+    if bwd_block_k is not None:
+        bwd_block_k = min(bwd_block_k, t)
+        assert t % bwd_block_k == 0
+    out = _flash_bhtd(
+        qf, kf, vf, block_q, block_k, interpret, causal,
+        bwd_block_q, bwd_block_k,
+    )
     out = out.reshape(b, h, t, d)
     return out if layout == "bhtd" else out.transpose(0, 2, 1, 3)
 
