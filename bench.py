@@ -301,7 +301,10 @@ def _verify_flash_grads() -> None:
                     "broken; do not trust flash training numbers"
                 )
 
-    check("rmw-fallback T=512 blocks 128", 512, 4, 64,
+    # n_k = 16 > 8 forces the rmw fallback (partials would need a 16-
+    # plane dq buffer); this is the branch with the undocumented
+    # non-consecutive-revisit HBM accumulation
+    check("rmw-fallback T=2048 blocks 128", 2048, 2, 64,
           dict(block_q=128, block_k=128))
     # the long-context production geometry: d_head=128, fwd 1024/1024,
     # bwd 512/2048 partials (n_k=2 planes)

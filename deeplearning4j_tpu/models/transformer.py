@@ -790,12 +790,12 @@ def _decode_builder(cfg: TransformerConfig):
                     flash_attention_trainable,
                 )
 
+                # forward-only (prefill never differentiates): no
+                # backward block overrides
                 bq, bk = _flash_blocks(tp)
-                bbq, bbk = _flash_bwd_blocks(tp)
                 o = flash_attention_trainable(
                     q, k_h, v_h, causal=True,
                     block_q=bq, block_k=bk, layout="bhtd",
-                    bwd_block_q=bbq, bwd_block_k=bbk,
                 )
             else:
                 o = attention(q, k_h, v_h, causal=True, layout="bhtd")

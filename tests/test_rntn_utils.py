@@ -121,7 +121,7 @@ def test_rntn_per_label_tables_on_treebank():
         num_classes=len(cats), dim=12, lr=0.1, seed=3, max_nodes=32,
         simplified_model=False, combine_classification=False, batch_size=10,
     )
-    losses = model.fit_trees(relabeled, epochs=20)
+    losses = model.fit_trees(relabeled, epochs=14)
     # the untied tables are real: one slot per discovered production
     assert len(model.prod_index) > 5
     assert model.params["W"].shape[0] == len(model.prod_index)
