@@ -116,7 +116,12 @@ class Glove:
         rows, cols, vals = count_cooccurrences(encoded, self.window)
         if len(rows) == 0:
             raise ValueError("empty co-occurrence matrix")
+        logx, fx, acc = self._init_weights(vals)
+        return rows, cols, logx, fx, acc
 
+    def _init_weights(self, vals: np.ndarray):
+        """Weight/bias/AdaGrad init + the GloVe weighting terms, shared
+        by the sentence and precomputed-co-occurrence fit paths."""
         v, d = len(self.cache), self.layer_size
         key = jax.random.key(self.seed)
         k1, k2 = jax.random.split(key)
@@ -124,11 +129,15 @@ class Glove:
         self.wc = (jax.random.uniform(k2, (v, d)) - 0.5) / d
         self.b = jnp.zeros((v,))
         self.bc = jnp.zeros((v,))
-        acc = (jnp.ones((v, d)), jnp.ones((v, d)), jnp.ones((v,)), jnp.ones((v,)))
-
+        acc = (
+            jnp.ones((v, d)), jnp.ones((v, d)),
+            jnp.ones((v,)), jnp.ones((v,)),
+        )
         logx = np.log(vals).astype(np.float32)
-        fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(np.float32)
-        return rows, cols, logx, fx, acc
+        fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(
+            np.float32
+        )
+        return logx, fx, acc
 
     def _run_epochs(self, step, data, acc, bsz: int, reshape=None) -> None:
         """Shared shuffle/batch/loss-history loop over the co-occurrence
@@ -155,6 +164,38 @@ class Glove:
 
     def fit(self, sentences: SentenceIterator) -> None:
         rows, cols, logx, fx, acc = self._prepare(sentences)
+        bsz = min(self.batch, len(rows))
+        self._run_epochs(_glove_step, (rows, cols, logx, fx), acc, bsz)
+
+    def fit_cooccurrences(self, triples) -> None:
+        """Train directly on precomputed ``(word_i, word_j, X_ij)``
+        triples — the artifact CoOccurrences.fit produces and
+        Glove.doIteration consumes in the reference (Glove.java:91,151;
+        CoOccurrences.java:69). Lets a real co-occurrence dump (e.g.
+        the reference's big/coc.txt fixture) drive the AdaGrad WLS
+        optimizer without re-counting."""
+        triples = [
+            (w1, w2, x) for w1, w2, x in
+            ((w1, w2, float(x)) for w1, w2, x in triples) if x > 0
+        ]
+        if not triples:
+            raise ValueError("empty co-occurrence input")
+        self.cache.fit([w1, w2] for w1, w2, _ in triples)
+        # drop triples whose words the min-frequency cutoff pruned: a -1
+        # index would wrap to the last vocab row in the jitted scatter
+        # and silently corrupt another word's embedding
+        kept = [
+            (self.cache.index_of(w1), self.cache.index_of(w2), x)
+            for w1, w2, x in triples
+        ]
+        kept = [(i, j, x) for i, j, x in kept if i >= 0 and j >= 0]
+        if not kept:
+            raise ValueError("all co-occurrence words pruned by "
+                             "min_word_frequency")
+        rows = np.asarray([i for i, _, _ in kept], np.int32)
+        cols = np.asarray([j for _, j, _ in kept], np.int32)
+        vals = np.asarray([x for _, _, x in kept], np.float32)
+        logx, fx, acc = self._init_weights(vals)
         bsz = min(self.batch, len(rows))
         self._run_epochs(_glove_step, (rows, cols, logx, fx), acc, bsz)
 

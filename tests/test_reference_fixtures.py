@@ -127,6 +127,41 @@ def test_tsne_on_reference_iris_preserves_classes():
     assert agreement > 0.9, agreement
 
 
+def test_glove_on_real_cooccurrence_fixture():
+    """GloVe's AdaGrad WLS trained directly on the reference's real
+    co-occurrence dump big/coc.txt (the artifact CoOccurrences.fit
+    produces, ≙ Glove.doIteration:151 consuming it): loss falls and the
+    learned factorization w_i·wc_j + b_i + bc_j actually tracks
+    log X_ij."""
+    from deeplearning4j_tpu.models.glove import Glove
+
+    path = _need(f"{NLP_RES}/big/coc.txt")
+    triples = []
+    for ln in open(path):
+        parts = ln.split()
+        if len(parts) == 3:
+            triples.append((parts[0], parts[1], float(parts[2])))
+    assert len(triples) > 20_000  # the real 26k-line fixture
+    g = Glove(layer_size=32, epochs=8, lr=0.05, batch=4096, seed=3)
+    g.fit_cooccurrences(triples)
+    assert g.loss_history[-1] < g.loss_history[0] / 2, g.loss_history
+    # the factorization explains the data: predicted log-counts
+    # correlate strongly with the fixture's actual log-counts
+    w = np.asarray(g.w)
+    wc = np.asarray(g.wc)
+    b = np.asarray(g.b)
+    bc = np.asarray(g.bc)
+    idx = np.random.default_rng(0).choice(len(triples), 4000, replace=False)
+    pred, logx = [], []
+    for k in idx:
+        w1, w2, x = triples[k]
+        i, j = g.cache.index_of(w1), g.cache.index_of(w2)
+        pred.append(w[i] @ wc[j] + b[i] + bc[j])
+        logx.append(np.log(x))
+    corr = np.corrcoef(pred, logx)[0, 1]
+    assert corr > 0.5, corr
+
+
 def test_tfidf_on_real_reuters_docs():
     """BoW/TF-IDF over the real Reuters articles in the reference tree:
     content words outrank stop words, and a doc-specific term stays
