@@ -39,7 +39,18 @@ def _start_status_rest(svc, args) -> None:
     shown = "127.0.0.1" if args.status_host == "0.0.0.0" else args.status_host
     print(f"status REST on http://{shown}:{port}/statetracker")
     if svc.auth_token is not None:
-        print(f"control POSTs require X-Auth-Token: {svc.auth_token}")
+        if getattr(args, "status_token", None) is not None:
+            # operator supplied the secret themselves — they know it;
+            # don't repeat it onto stdout (often captured into logs)
+            print("control POSTs require X-Auth-Token (as passed via "
+                  "--status-token)")
+        else:
+            print(
+                "control POSTs require X-Auth-Token: "
+                f"{svc.auth_token[:8]}… (full secret in "
+                f"{getattr(svc, 'auth_token_file', '<token file>')}, "
+                "mode 0600)"
+            )
 
 
 def _train_transformer(args) -> int:

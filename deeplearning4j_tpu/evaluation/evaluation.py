@@ -88,14 +88,71 @@ class Evaluation:
         r = self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
 
+    def false_positives(self, cls: int) -> int:
+        return self.confusion.predicted_total(cls) - self._tp(cls)
+
+    def false_negatives(self, cls: int) -> int:
+        return self.confusion.actual_total(cls) - self._tp(cls)
+
     def stats(self) -> str:
-        """Text report (≙ Evaluation.stats:81)."""
-        lines = ["==========================Scores=================================="]
+        """Text report (≙ Evaluation.stats:81).
+
+        Like the reference, enumerates every non-zero confusion cell
+        ("Actual Class i was predicted with Predicted j with count n
+        times"), then adds the per-class table the raw counts imply
+        (precision/recall/F1 with tp/fp/fn and support per class — the
+        math ``precision(cls)``/``recall(cls)``/``f1(cls)`` already
+        expose) before the aggregate scores."""
+        m = self.confusion
+        lines = [""]
+        # vectorized over the counts matrix (a Python m.count() loop is
+        # O(C^2) calls, ~1s at C=2000) and capped: stats() is built as
+        # assert messages, so a dense large-C matrix must not explode
+        # into millions of report lines — keep the top cells by count
+        cells = np.argwhere(m.counts)
+        max_cells = 1000
+        if len(cells) > max_cells:
+            vals = m.counts[cells[:, 0], cells[:, 1]]
+            cells = cells[np.argsort(-vals)[:max_cells]]
+            cells = cells[np.lexsort((cells[:, 1], cells[:, 0]))]
+            lines.append(
+                f"(showing the {max_cells} largest of "
+                f"{int(np.count_nonzero(m.counts))} non-zero cells)"
+            )
+        for a, p in cells:
+            lines.append(
+                f"Actual Class {a} was predicted with Predicted "
+                f"{p} with count {m.counts[a, p]} times"
+            )
+        lines.append("")
+        lines.append("=========================Per-class========================")
+        lines.append(
+            " class    tp    fp    fn  support  precision  recall      f1"
+        )
+        tp = np.diag(m.counts)
+        support = m.counts.sum(axis=1)
+        fp = m.counts.sum(axis=0) - tp
+        fn = support - tp
+        with np.errstate(divide="ignore", invalid="ignore"):
+            prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1), 0.0)
+            rec = np.where(support > 0, tp / np.maximum(support, 1), 0.0)
+            f1 = np.where(
+                prec + rec > 0,
+                2 * prec * rec / np.maximum(prec + rec, 1e-30),
+                0.0,
+            )
+        for c in range(self.num_classes):
+            lines.append(
+                f" {c:>5} {tp[c]:>5} {fp[c]:>5} {fn[c]:>5} "
+                f"{support[c]:>8} "
+                f"{prec[c]:>10.4f} {rec[c]:>7.4f} {f1[c]:>7.4f}"
+            )
+        lines.append("==========================Scores==========================")
         lines.append(f" Accuracy:  {self.accuracy():.4f}")
         lines.append(f" Precision: {self.precision():.4f}")
         lines.append(f" Recall:    {self.recall():.4f}")
         lines.append(f" F1 Score:  {self.f1():.4f}")
         lines.append("===========================================================")
         lines.append("Confusion matrix (rows=actual, cols=predicted):")
-        lines.append(str(self.confusion.counts))
+        lines.append(str(m.counts))
         return "\n".join(lines)

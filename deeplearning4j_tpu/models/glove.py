@@ -105,6 +105,7 @@ class Glove:
         self.tokenizer = tokenizer or DefaultTokenizer()
         self.cache = VocabCache(min_word_frequency)
         self.w = self.wc = self.b = self.bc = None
+        self._acc = None  # AdaGrad history, kept for continue-training
         self.loss_history: list[float] = []
 
     def _prepare(self, sentences: SentenceIterator):
@@ -119,20 +120,27 @@ class Glove:
         logx, fx, acc = self._init_weights(vals)
         return rows, cols, logx, fx, acc
 
-    def _init_weights(self, vals: np.ndarray):
+    def _init_weights(self, vals: np.ndarray, reset: bool = True):
         """Weight/bias/AdaGrad init + the GloVe weighting terms, shared
-        by the sentence and precomputed-co-occurrence fit paths."""
+        by the sentence and precomputed-co-occurrence fit paths.
+        ``reset=False`` keeps already-trained weights (the continue-
+        training path) and only rebuilds the per-triple terms."""
         v, d = len(self.cache), self.layer_size
-        key = jax.random.key(self.seed)
-        k1, k2 = jax.random.split(key)
-        self.w = (jax.random.uniform(k1, (v, d)) - 0.5) / d
-        self.wc = (jax.random.uniform(k2, (v, d)) - 0.5) / d
-        self.b = jnp.zeros((v,))
-        self.bc = jnp.zeros((v,))
-        acc = (
-            jnp.ones((v, d)), jnp.ones((v, d)),
-            jnp.ones((v,)), jnp.ones((v,)),
-        )
+        if reset or self.w is None:
+            key = jax.random.key(self.seed)
+            k1, k2 = jax.random.split(key)
+            self.w = (jax.random.uniform(k1, (v, d)) - 0.5) / d
+            self.wc = (jax.random.uniform(k2, (v, d)) - 0.5) / d
+            self.b = jnp.zeros((v,))
+            self.bc = jnp.zeros((v,))
+            self._acc = None
+        if self._acc is not None:
+            acc = self._acc  # continue-training keeps the AdaGrad history
+        else:
+            acc = (
+                jnp.ones((v, d)), jnp.ones((v, d)),
+                jnp.ones((v,)), jnp.ones((v,)),
+            )
         logx = np.log(vals).astype(np.float32)
         fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(
             np.float32
@@ -161,6 +169,10 @@ class Glove:
                 epoch_loss += float(loss)
                 nb += 1
             self.loss_history.append(epoch_loss / max(nb, 1))
+        # keep the final AdaGrad history so a continue-training call
+        # (fit_cooccurrences after fit) steps with the accumulated h,
+        # not a fresh near-full-lr restart on already-trained rows
+        self._acc = (hw, hwc, hb, hbc)
 
     def fit(self, sentences: SentenceIterator) -> None:
         rows, cols, logx, fx, acc = self._prepare(sentences)
@@ -173,14 +185,33 @@ class Glove:
         Glove.doIteration consumes in the reference (Glove.java:91,151;
         CoOccurrences.java:69). Lets a real co-occurrence dump (e.g.
         the reference's big/coc.txt fixture) drive the AdaGrad WLS
-        optimizer without re-counting."""
+        optimizer without re-counting.
+
+        Caveats (ADVICE r4):
+
+        - ``min_word_frequency`` here counts how often a word appears
+          across the *triples* (each triple contributes one occurrence
+          per member), NOT corpus token frequency — the corpus is not
+          available in this path, so the cutoff semantics necessarily
+          diverge from the reference's CoOccurrences (which prunes on
+          corpus counts before counting pairs).
+        - if a vocab was already built (``fit()`` ran first), it is
+          reused rather than rebuilt, trained weights AND AdaGrad
+          history are kept (continue-training), and triples whose words
+          are out-of-vocab are dropped. (``fit()`` itself has no such
+          guard: VocabCache.fit ACCUMULATES, so calling ``fit()`` twice
+          on one model corrupts the word↔index mapping — build the
+          vocab once, then continue with this method.)
+        """
         triples = [
             (w1, w2, x) for w1, w2, x in
             ((w1, w2, float(x)) for w1, w2, x in triples) if x > 0
         ]
         if not triples:
             raise ValueError("empty co-occurrence input")
-        self.cache.fit([w1, w2] for w1, w2, _ in triples)
+        had_vocab = len(self.cache) > 0
+        if not had_vocab:
+            self.cache.fit([w1, w2] for w1, w2, _ in triples)
         # drop triples whose words the min-frequency cutoff pruned: a -1
         # index would wrap to the last vocab row in the jitted scatter
         # and silently corrupt another word's embedding
@@ -195,7 +226,7 @@ class Glove:
         rows = np.asarray([i for i, _, _ in kept], np.int32)
         cols = np.asarray([j for _, j, _ in kept], np.int32)
         vals = np.asarray([x for _, _, x in kept], np.float32)
-        logx, fx, acc = self._init_weights(vals)
+        logx, fx, acc = self._init_weights(vals, reset=not had_vocab)
         bsz = min(self.batch, len(rows))
         self._run_epochs(_glove_step, (rows, cols, logx, fx), acc, bsz)
 

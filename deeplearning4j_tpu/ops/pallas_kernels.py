@@ -105,6 +105,15 @@ def _causal_dispatch(
 # summed in f32 outside the kernel (no HBM read-modify-write); False =
 # f32 rmw accumulation in the dq output block across kv revisits
 _DQ_PARTIALS = True
+# debugging escape hatch (ADVICE r4): store the dq partial planes in
+# f32 instead of the input dtype, restoring the rmw path's backward
+# precision at 2x the plane HBM. Flip when triaging suspected grad
+# corruption on device — if f32 partials fix it, the bf16 ds/plane
+# rounding is implicated; if not, look at the accumulation structure.
+# (The routine guard is bench._verify_flash_grads, which runs the
+# production bwd geometry against dense autodiff on the real TPU every
+# bench round; interpret-mode CPU tests cannot observe device drift.)
+_DQ_PARTIALS_F32 = False
 
 
 def _dim_semantics(interpret, semantics=("parallel", "parallel", "arbitrary")):
@@ -369,7 +378,8 @@ def _flash_bwd_rule(
     # those fall back to the rmw accumulation path
     dq_partials = _DQ_PARTIALS and n_k <= 8
     if dq_partials:
-        dq_shape = jax.ShapeDtypeStruct((n_k, bh, t, d), qf.dtype)
+        plane_dtype = jnp.float32 if _DQ_PARTIALS_F32 else qf.dtype
+        dq_shape = jax.ShapeDtypeStruct((n_k, bh, t, d), plane_dtype)
         dq_spec = pl.BlockSpec(
             (1, 1, block_q, d), lambda i, j, qq: (j, i, qq, 0)
         )

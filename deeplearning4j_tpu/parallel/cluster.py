@@ -60,6 +60,9 @@ class ClusterService:
         # shared secret for control POSTs on non-loopback binds (set by
         # start_rest_api; None = no auth, loopback-only default)
         self.auth_token: str | None = None
+        # path of the 0600 file holding a *generated* secret (None when
+        # the operator supplied the token); unlinked by stop_rest_api
+        self.auth_token_file: str | None = None
         self._server: ThreadingHTTPServer | None = None
 
     # -- worker registry / heartbeats -------------------------------------
@@ -133,16 +136,11 @@ class ClusterService:
         status)."""
         service = self
         loopback = host in ("127.0.0.1", "localhost", "::1")
-        if auth_token is None and not loopback:
-            import logging
+        generated = auth_token is None and not loopback
+        if generated:
             import secrets
 
             auth_token = secrets.token_hex(16)
-            logging.getLogger(__name__).warning(
-                "ClusterService REST bound to %s: control POSTs are "
-                "network-writable; generated auth token %s (clients must "
-                "send it as X-Auth-Token)", host, auth_token,
-            )
         self.auth_token = auth_token
 
         from deeplearning4j_tpu.utils.httpjson import (
@@ -224,14 +222,50 @@ class ClusterService:
                 return self._json(404, {"error": "unknown endpoint"})
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        if generated:
+            # Persist + log the generated secret only AFTER the bind
+            # succeeded: a failed bind must not orphan a secret file (the
+            # caller never reaches stop_rest_api). Never write the full
+            # secret to the log stream (CWE-532, ADVICE r4) — logs are
+            # routinely shipped with wider read access than the box.
+            # Mode-0600 file + fingerprint prefix in the log lets an
+            # operator correlate without gaining mutation rights.
+            import logging
+            import os
+            import tempfile
+
+            # repeated start without stop: drop the previous secret
+            self._discard_token_file()
+            # mkstemp creates the file 0600 per POSIX — no chmod needed
+            fd, token_path = tempfile.mkstemp(prefix="dl4j_tpu_token_")
+            with os.fdopen(fd, "w") as f:
+                f.write(auth_token)
+            self.auth_token_file = token_path
+            logging.getLogger(__name__).warning(
+                "ClusterService REST bound to %s: control POSTs are "
+                "network-writable; generated auth token %s… (full secret "
+                "in %s, mode 0600 — clients send it as X-Auth-Token)",
+                host, auth_token[:8], token_path,
+            )
         thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         thread.start()
         return self._server.server_address[1]
+
+    def _discard_token_file(self) -> None:
+        """Unlink the generated-secret file (if any); one lifecycle site."""
+        if self.auth_token_file is not None:
+            import contextlib
+            import os
+
+            with contextlib.suppress(OSError):
+                os.unlink(self.auth_token_file)
+            self.auth_token_file = None
 
     def stop_rest_api(self) -> None:
         if self._server:
             self._server.shutdown()
             self._server = None
+        self._discard_token_file()
 
 
 class FileRegistry:

@@ -177,6 +177,38 @@ def test_statetracker_rest_auth_token():
         svc.stop_rest_api()
 
 
+def test_statetracker_generated_token_not_logged(caplog, tmp_path):
+    """A generated control token must never appear in the log stream
+    (CWE-532, ADVICE r4): only an 8-char fingerprint is logged; the full
+    secret goes to a mode-0600 file."""
+    import logging
+    import os
+    import stat
+
+    from deeplearning4j_tpu.parallel.cluster import ClusterService
+
+    svc = ClusterService()
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.parallel.cluster"):
+        port = svc.start_rest_api(0, host="0.0.0.0")
+    try:
+        token = svc.auth_token
+        assert token is not None and len(token) == 32
+        log_text = caplog.text
+        assert token not in log_text, "full secret leaked to the log"
+        assert token[:8] in log_text  # fingerprint for correlation
+        path = svc.auth_token_file
+        assert os.path.exists(path)
+        mode = stat.S_IMODE(os.stat(path).st_mode)
+        assert mode == 0o600
+        with open(path) as f:
+            assert f.read() == token
+    finally:
+        svc.stop_rest_api()
+    # stop cleans up the secret file (no stale token left in /tmp)
+    assert svc.auth_token_file is None and not os.path.exists(path)
+
+
 def test_statetracker_rest_post_control():
     from deeplearning4j_tpu.parallel.cluster import ClusterService
 

@@ -92,6 +92,48 @@ def test_flash_attention_trainable_causal_grads_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+def test_flash_backward_f32_partials_escape_hatch():
+    """The _DQ_PARTIALS_F32 debug flag (ADVICE r4) must produce correct
+    grads through the f32-plane path so it is actually usable when
+    triaging suspected device grad corruption. Inputs are bf16 — with
+    f32 inputs the plane dtype is f32 either way and the flag would be
+    a no-op (the flag's whole point is bf16-storage runs)."""
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    from deeplearning4j_tpu.ops.attention import attention
+
+    rng = np.random.default_rng(3)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, 128, 2, 8)).astype(np.float32))
+        .astype(jnp.bfloat16)
+        for _ in range(3)
+    )
+
+    def loss_dense(q, k, v):
+        o = attention(q, k, v, causal=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    old = pk._DQ_PARTIALS_F32
+    pk._DQ_PARTIALS_F32 = True
+    try:
+        def loss_flash(q, k, v):
+            o = pk.flash_attention_trainable(
+                q, k, v, block_q=32, block_k=32, causal=True
+            )
+            return jnp.sum(o * jnp.cos(o))
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        pk._DQ_PARTIALS_F32 = old
+    # bf16 storage: tolerance scaled to bf16 resolution; grads of the
+    # two paths must agree to within rounding, not diverge structurally
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0.06, atol=3e-2,
+        )
+
+
 def _dense_decode_ref(q, kvcache, pos, n_kv_heads, layer):
     """Dense einsum oracle for one decode step against the packed cache."""
     b, g, hk = q.shape

@@ -15,6 +15,13 @@ same with the files that physically ship in the reference tree:
   so the t-SNE gate uses the other real fixture)
 
 All tests skip cleanly when the reference mount is absent.
+
+Triage note (ADVICE r4): the gates marked ``statistical`` below assert
+thresholds on seeded-but-platform-sensitive training runs. A jaxlib /
+hardware / RNG-implementation change can move the measured value with no
+repo bug; when one of these fails in isolation, compare against the
+stamp-time margin recorded at the assert site and triage as environment
+drift BEFORE suspecting a code regression.
 """
 
 import numpy as np
@@ -73,6 +80,7 @@ def test_mlp_on_reference_iris_dat():
     assert ev.accuracy() > 0.85, ev.stats()
 
 
+@pytest.mark.slow
 def test_word2vec_real_corpus_similarity_bound():
     """Train on the real raw_sentences.txt corpus and assert the
     similarity("day","night") bound ≙ Word2VecTests.java — the corpus
@@ -93,8 +101,11 @@ def test_word2vec_real_corpus_similarity_bound():
     )
     w2v.fit(CollectionSentenceIterator(sub))
     sim = w2v.similarity("day", "night")
+    # statistical gate — stamp-time margin (2026-07-31, jax 0.9.0 CPU):
+    # measured sim 0.909 vs the 0.65 bound; see module triage note
     assert sim > 0.65, sim
     # and the bound is meaningful: an unrelated pair scores clearly lower
+    # (stamp-time: 0.909 vs 0.697 + 0.1)
     assert sim > w2v.similarity("day", "office") + 0.1
 
 
@@ -112,6 +123,7 @@ def test_load_google_model_real_bin_and_txt():
     assert np.max(np.abs(vb - vt)) < 1e-5
 
 
+@pytest.mark.slow
 def test_tsne_on_reference_iris_preserves_classes():
     """t-SNE on the real iris.dat features: the 2-D embedding keeps
     same-class points as nearest neighbours (the reference's TsneTest
@@ -124,9 +136,12 @@ def test_tsne_on_reference_iris_preserves_classes():
     d = ((emb[:, None, :] - emb[None, :, :]) ** 2).sum(-1)
     np.fill_diagonal(d, np.inf)
     agreement = (y[d.argmin(1)] == y).mean()
+    # statistical gate — stamp-time margin (2026-07-31, jax 0.9.0 CPU):
+    # measured agreement 0.967 vs the 0.9 bound; see module triage note
     assert agreement > 0.9, agreement
 
 
+@pytest.mark.slow
 def test_glove_on_real_cooccurrence_fixture():
     """GloVe's AdaGrad WLS trained directly on the reference's real
     co-occurrence dump big/coc.txt (the artifact CoOccurrences.fit
