@@ -87,6 +87,14 @@ class TransformerConfig:
     # tile-padding tax of a (B, T, H, K) cache). False falls back to the
     # dense einsum path (useful under SPMD sharding or for debugging).
     decode_kernel: bool = True
+    # int8 serving mode (r5): decode expects params produced by
+    # :func:`quantize_decode_params` (weight-only int8, per-output-
+    # channel scales, dequant fused into the matmul reads) AND stores
+    # the KV cache int8 with per-row scales (the kernel dequantizes
+    # in-register). Halves the two HBM streams that bound decode —
+    # the 247MB/step weight stream and the ~345MB/step cache stream at
+    # B=16 (PERF.md "0.60-MBU wall"). Training paths ignore this flag.
+    decode_int8: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -183,6 +191,64 @@ def init_transformer(key, cfg: TransformerConfig):
         "lnf_bias": jnp.zeros((d,)),
         "head": norm(ks[6], (d, cfg.vocab_size), s_d),
     }
+
+
+# block-weight leaves quantized for int8 decode, with the axes reduced
+# by their matmuls (the scale is per-OUTPUT-channel: max|w| over the
+# contraction axes). head contracts d (axis 0).
+_INT8_BLOCK_AXES = {
+    "wqkv": (1,), "wq": (1,), "wkv": (1,),
+    "wo": (1, 2), "w1": (1,), "w2": (1,),
+}
+
+
+def _quantize_int8(w, axes):
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_decode_params(params, cfg: TransformerConfig):
+    """Weight-only int8 quantization of the decode-streamed matmul
+    weights (block projections/MLP + head), per-output-channel scales.
+
+    Returns a params pytree of the same structure with each quantized
+    leaf ``name`` stored int8 and a sibling ``name_scale`` f32 leaf;
+    embeddings/positions (gather-read, not streamed per step) and
+    norm scales/biases stay float. Decode paths dequantize inside the
+    jitted program — XLA fuses the int8 read + convert + scale into the
+    matmul operand, so the per-step HBM weight stream halves vs bf16.
+    Pair with ``dataclasses.replace(cfg, decode_int8=True)``.
+    """
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "int8 decode quantization does not cover MoE experts yet"
+        )
+    blocks = dict(params["blocks"])
+    for name, axes in _INT8_BLOCK_AXES.items():
+        if name in blocks:
+            q, s = _quantize_int8(blocks[name], axes)
+            blocks[name] = q
+            blocks[name + "_scale"] = s
+    out = dict(params)
+    out["blocks"] = blocks
+    hq, hs = _quantize_int8(params["head"], (0,))
+    out["head"] = hq
+    out["head_scale"] = hs
+    return out
+
+
+def _w(p, name, dtype):
+    """Read a (possibly int8-quantized) weight leaf at compute dtype.
+
+    For quantized leaves the dequant (convert + per-channel scale) is
+    expressed inline so XLA fuses it into the consuming matmul's operand
+    read — the HBM traffic is the int8 bytes, not a dequantized copy."""
+    w = p[name]
+    if w.dtype == jnp.int8:
+        return (w.astype(jnp.float32) * p[name + "_scale"]).astype(dtype)
+    return w.astype(dtype)
 
 
 def transformer_shardings(mesh: Mesh, cfg: TransformerConfig | None = None):
@@ -338,13 +404,13 @@ def _project_qkv(cfg: TransformerConfig, p, h_in):
     UNexpanded k/v (B, H_kv, T, K). One implementation so GQA/MHA
     layouts cannot drift between the paths."""
     if cfg.kv_heads != cfg.n_heads:
-        q = jnp.einsum("btd,dhk->bhtk", h_in, p["wq"].astype(h_in.dtype))
+        q = jnp.einsum("btd,dhk->bhtk", h_in, _w(p, "wq", h_in.dtype))
         kv = jnp.einsum(
-            "btd,dshk->sbhtk", h_in, p["wkv"].astype(h_in.dtype)
+            "btd,dshk->sbhtk", h_in, _w(p, "wkv", h_in.dtype)
         )
         return q, kv[0], kv[1]
     qkv = jnp.einsum(
-        "btd,dshk->sbhtk", h_in, p["wqkv"].astype(h_in.dtype)
+        "btd,dshk->sbhtk", h_in, _w(p, "wqkv", h_in.dtype)
     )
     return qkv[0], qkv[1], qkv[2]
 
@@ -360,11 +426,11 @@ def _expand_kv(cfg: TransformerConfig, k_r, v_r):
 def _mlp(p, h_in):
     """Shared dense FFN (gelu) over (..., D) activations."""
     h = jax.nn.gelu(
-        jnp.einsum("...d,df->...f", h_in, p["w1"].astype(h_in.dtype))
+        jnp.einsum("...d,df->...f", h_in, _w(p, "w1", h_in.dtype))
         + p["b1"].astype(h_in.dtype)
     )
     return (
-        jnp.einsum("...f,fd->...d", h, p["w2"].astype(h_in.dtype))
+        jnp.einsum("...f,fd->...d", h, _w(p, "w2", h_in.dtype))
         + p["b2"].astype(h_in.dtype)
     )
 
@@ -476,7 +542,7 @@ def transformer_apply(
                 attention(q_h, k_h, v_h, causal=True, layout="bhtd"),
                 "attn_out",
             )
-        x = x + jnp.einsum("bhtk,hkd->btd", o, p["wo"].astype(x.dtype))
+        x = x + jnp.einsum("bhtk,hkd->btd", o, _w(p, "wo", x.dtype))
         # ffn sublayer: dense MLP or routed MoE
         h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
         if cfg.n_experts:
@@ -585,6 +651,14 @@ def _decode_builder(cfg: TransformerConfig):
     search. ``forward_one(params, caches, token, pos)`` advances one
     position through all layers."""
 
+    def quantize_kv_rows(rows):
+        """Per-row int8 quantization of new cache rows: ``rows``
+        (..., hk) -> (int8 rows, f32 scales (..., 1)). The row (one
+        position's packed heads) is the finest granularity the kernel
+        can rescale without per-head bookkeeping; measured logits error
+        vs bf16 cache is ~0.3% on random models."""
+        return _quantize_int8(rows.astype(jnp.float32), (-1,))
+
     def block_decode(x, p, kv_all, i, pos):
         # x: (B, D) one position; kv_all: the ONE stacked packed cache
         # (nl, 2, B, Tpad, Hkv*K) (axis 1: K then V) — this layer writes
@@ -602,12 +676,12 @@ def _decode_builder(cfg: TransformerConfig):
         grp = cfg.n_heads // cfg.kv_heads
         h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
         if cfg.kv_heads != cfg.n_heads:
-            q = jnp.einsum("bd,dhk->bhk", h_in, p["wq"].astype(x.dtype))
-            kv = jnp.einsum("bd,dshk->sbhk", h_in, p["wkv"].astype(x.dtype))
+            q = jnp.einsum("bd,dhk->bhk", h_in, _w(p, "wq", x.dtype))
+            kv = jnp.einsum("bd,dshk->sbhk", h_in, _w(p, "wkv", x.dtype))
             k, v = kv[0], kv[1]
         else:
             qkv = jnp.einsum(
-                "bd,dshk->sbhk", h_in, p["wqkv"].astype(x.dtype)
+                "bd,dshk->sbhk", h_in, _w(p, "wqkv", x.dtype)
             )
             q, k, v = qkv[0], qkv[1], qkv[2]
         if cfg.rope:
@@ -617,9 +691,22 @@ def _decode_builder(cfg: TransformerConfig):
         kv_row = jnp.stack(
             [k.reshape(b, -1), v.reshape(b, -1)]
         )[None, :, :, None, :]  # (1, 2, B, 1, Hkv*K)
-        kv_all = lax.dynamic_update_slice(
-            kv_all, kv_row.astype(kv_all.dtype), (i, 0, 0, pos, 0)
-        )
+        if cfg.decode_int8:
+            kv_buf, sc_buf = kv_all["kv"], kv_all["scale"]
+            q_row, s_row = quantize_kv_rows(kv_row)
+            kv_buf = lax.dynamic_update_slice(
+                kv_buf, q_row, (i, 0, 0, pos, 0)
+            )
+            sc_buf = lax.dynamic_update_slice(
+                sc_buf, s_row, (i, 0, 0, pos, 0)
+            )
+            kv_all = {"kv": kv_buf, "scale": sc_buf}
+        else:
+            kv_buf, sc_buf = kv_all, None
+            kv_buf = lax.dynamic_update_slice(
+                kv_buf, kv_row.astype(kv_buf.dtype), (i, 0, 0, pos, 0)
+            )
+            kv_all = kv_buf
         if cfg.decode_kernel:
             from deeplearning4j_tpu.ops.pallas_kernels import (
                 flash_decode_attention,
@@ -636,7 +723,8 @@ def _decode_builder(cfg: TransformerConfig):
             # layer in its index map — slicing here would materialize a
             # full-cache copy per layer (custom calls need dense operands)
             o = flash_decode_attention(
-                qp, kv_all, pos, n_kv_heads=cfg.kv_heads, layer=i
+                qp, kv_buf, pos, n_kv_heads=cfg.kv_heads, layer=i,
+                kv_scales=sc_buf,
             )
             o_flat = (
                 o.reshape(b, grp, cfg.kv_heads, kd)
@@ -644,8 +732,18 @@ def _decode_builder(cfg: TransformerConfig):
                 .reshape(b, cfg.n_heads * kd)
             )
         else:
-            ck4 = kv_all[i, 0].reshape(b, -1, cfg.kv_heads, kd)
-            cv4 = kv_all[i, 1].reshape(b, -1, cfg.kv_heads, kd)
+            if cfg.decode_int8:
+                # dense fallback dequantizes the whole visible cache —
+                # debugging path only; the kernel path dequantizes
+                # in-register
+                ck = (kv_buf[i, 0].astype(jnp.float32)
+                      * sc_buf[i, 0]).astype(x.dtype)
+                cv = (kv_buf[i, 1].astype(jnp.float32)
+                      * sc_buf[i, 1]).astype(x.dtype)
+            else:
+                ck, cv = kv_buf[i, 0], kv_buf[i, 1]
+            ck4 = ck.reshape(b, -1, cfg.kv_heads, kd)
+            cv4 = cv.reshape(b, -1, cfg.kv_heads, kd)
             qg = q.reshape(b, cfg.kv_heads, grp, kd)
             logits = jnp.einsum(
                 "bhgk,bthk->bhgt", qg, ck4
@@ -655,7 +753,7 @@ def _decode_builder(cfg: TransformerConfig):
             w = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bhgt,bthk->bhgk", w, cv4)
             o_flat = o.reshape(b, cfg.n_heads * kd)
-        x = x + o_flat @ p["wo"].astype(x.dtype).reshape(
+        x = x + o_flat @ _w(p, "wo", x.dtype).reshape(
             cfg.n_heads * kd, -1
         )
         h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
@@ -692,13 +790,13 @@ def _decode_builder(cfg: TransformerConfig):
             p_i = jax.tree.map(lambda a: a[i], params["blocks"])
             x, kv_all = block_decode(x, p_i, kv_all, i, pos)
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-        # head matmul with bf16 OPERANDS (half the weight stream and the
-        # MXU fast path — decode is weight-streaming-bound) but f32
-        # ACCUMULATION: a bf16-output dot would quantize the logits to
-        # 8 mantissa bits, creating arbitrary ties at the top-k
-        # threshold and in beam scores over V=50k
+        # head matmul with bf16 (or dequantized-int8) OPERANDS — half/
+        # quarter the weight stream and the MXU fast path; decode is
+        # weight-streaming-bound — but f32 ACCUMULATION: a bf16-output
+        # dot would quantize the logits to 8 mantissa bits, creating
+        # arbitrary ties at the top-k threshold and in beam scores
         logits = jnp.einsum(
-            "bd,dv->bv", x, params["head"].astype(x.dtype),
+            "bd,dv->bv", x, _w(params, "head", x.dtype),
             preferred_element_type=jnp.float32,
         )
         return logits, kv_all
@@ -709,15 +807,29 @@ def _decode_builder(cfg: TransformerConfig):
         Decode is HBM-bound on the weight stream: without this, every
         per-step fused matmul re-reads f32 weights and converts inline —
         2x the bytes of the bf16 stream. Called once at the top of the
-        jitted generate/beam program; a no-op at f32."""
+        jitted generate/beam program; a no-op at f32. int8-quantized
+        leaves (and their f32 per-channel scales) pass through
+        untouched: the int8 bytes ARE the stream, and the scales must
+        stay f32 for the fused dequant."""
+
+        quant_scales = {n + "_scale" for n in _INT8_BLOCK_AXES}
+
+        def cast_leaf(a):
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(cfg.compute_dtype)
+            return a
+
+        def cast(name, a):
+            if name in quant_scales:  # NOT ln1_scale/ln2_scale
+                return a
+            # a may itself be a pytree (MoEParams): cast its leaves
+            return jax.tree.map(cast_leaf, a)
         out = dict(params)
-        out["blocks"] = jax.tree.map(
-            lambda a: a.astype(cfg.compute_dtype)
-            if jnp.issubdtype(a.dtype, jnp.floating)
-            else a,
-            params["blocks"],
-        )
-        out["head"] = params["head"].astype(cfg.compute_dtype)
+        out["blocks"] = {
+            name: cast(name, a) for name, a in params["blocks"].items()
+        }
+        if params["head"].dtype != jnp.int8:
+            out["head"] = params["head"].astype(cfg.compute_dtype)
         return out
 
     def init_caches(batch: int, total: int):
@@ -735,6 +847,17 @@ def _decode_builder(cfg: TransformerConfig):
             tpad = -(-total // _DECODE_PAD_T) * _DECODE_PAD_T
         else:
             tpad = -(-total // 512) * 512
+        if cfg.decode_int8:
+            # int8 rows + per-row f32 scales (trailing singleton keeps
+            # the scale blocks Mosaic-legal: last dim 1 = full dim)
+            return {
+                "kv": jnp.zeros(
+                    (nl, 2, batch, tpad, h * kd), jnp.int8
+                ),
+                "scale": jnp.zeros(
+                    (nl, 2, batch, tpad, 1), jnp.float32
+                ),
+            }
         return jnp.zeros(
             (nl, 2, batch, tpad, h * kd), cfg.compute_dtype
         )
@@ -764,7 +887,7 @@ def _decode_builder(cfg: TransformerConfig):
             sin_b = sin[None, None, :, :]
 
         def layer(x, xs):
-            p, kv = xs  # kv: (2, B, Tpad, Hkv*K)
+            p, kv = xs  # kv: (2, B, Tpad, Hkv*K); int8 mode: dict
             h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
             q, k_r, v_r = _project_qkv(cfg, p, h_in)
             if cfg.rope:
@@ -777,9 +900,20 @@ def _decode_builder(cfg: TransformerConfig):
                     v_r.transpose(0, 2, 1, 3).reshape(b, tp, -1),
                 ]
             )
-            kv = lax.dynamic_update_slice(
-                kv, kv_rows.astype(kv.dtype), (0, 0, 0, 0)
-            )
+            if cfg.decode_int8:
+                q_rows, s_rows = quantize_kv_rows(kv_rows)
+                kv = {
+                    "kv": lax.dynamic_update_slice(
+                        kv["kv"], q_rows, (0, 0, 0, 0)
+                    ),
+                    "scale": lax.dynamic_update_slice(
+                        kv["scale"], s_rows, (0, 0, 0, 0)
+                    ),
+                }
+            else:
+                kv = lax.dynamic_update_slice(
+                    kv, kv_rows.astype(kv.dtype), (0, 0, 0, 0)
+                )
             k_h, v_h = _expand_kv(cfg, k_r, v_r)
             if cfg.use_flash and _flash_seq_ok(tp):
                 # keep long-prompt prefill O(T) like training — dense
@@ -799,7 +933,7 @@ def _decode_builder(cfg: TransformerConfig):
                 )
             else:
                 o = attention(q, k_h, v_h, causal=True, layout="bhtd")
-            x = x + jnp.einsum("bhtk,hkd->btd", o, p["wo"].astype(x.dtype))
+            x = x + jnp.einsum("bhtk,hkd->btd", o, _w(p, "wo", x.dtype))
             h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
             if cfg.n_experts:
                 from deeplearning4j_tpu.parallel.expert_parallel import (
@@ -824,7 +958,7 @@ def _decode_builder(cfg: TransformerConfig):
             x[:, -1], params["lnf_scale"], params["lnf_bias"]
         )
         logits = jnp.einsum(
-            "bd,dv->bv", x, params["head"].astype(x.dtype),
+            "bd,dv->bv", x, _w(params, "head", x.dtype),
             preferred_element_type=jnp.float32,
         )  # bf16 operands, f32 accumulation — see forward_one
         return kv_all, logits
@@ -919,7 +1053,11 @@ def transformer_beam_search(cfg: TransformerConfig):
         # prefill once at batch B, then tile caches/logits to B*W beams
         params = cast_params(params)
         caches, logits = do_prefill(params, init_caches(b, total), prompt)
-        caches = jnp.repeat(caches, w, axis=2)  # (nl, 2, B*W, Tpad, Hkv*K)
+        # tree-mapped: int8 mode carries {"kv", "scale"}, both with the
+        # cache batch on axis 2
+        caches = jax.tree.map(
+            lambda a: jnp.repeat(a, w, axis=2), caches
+        )  # (nl, 2, B*W, Tpad, ...)
         logp = jax.nn.log_softmax(logits, axis=-1)  # (B, V)
         # beam 0 holds the live hypothesis; the rest start at -inf so the
         # first expansion draws W distinct tokens from beam 0's logits
@@ -945,7 +1083,9 @@ def transformer_beam_search(cfg: TransformerConfig):
             flat_parent = (
                 jnp.arange(b)[:, None] * w + parent
             ).reshape(-1)  # (B*W,) into the cache batch dim
-            caches = jnp.take(caches, flat_parent, axis=2)
+            caches = jax.tree.map(
+                lambda a: jnp.take(a, flat_parent, axis=2), caches
+            )
             logits, caches = forward_one(
                 params, caches, tok.reshape(-1), tp + i
             )
