@@ -26,6 +26,12 @@ per workload — the driver's round record captures all of them:
                   (weight-only int8 params + int8 KV cache with
                   per-row scales) — halves both HBM streams the bf16
                   decode wall analysis bounds (PERF.md)
+- ``transformer-decode-gqa`` / ``-gqa-b64`` / ``-gqa-b64-int8`` the
+                  production decode geometry (6 query heads over 2 KV
+                  heads + RoPE): 3x smaller cache stream; the -int8
+                  composite is the headline serving point
+- ``transformer-flash-32k`` long-context training at T=32768 (B=1) —
+                  the regime where dense attention cannot compile
 
 ``--model X`` runs a single workload. ``--scaling`` reports 1->N-chip
 data-parallel efficiency (lenet/alexnet); ``--profile DIR`` captures an
@@ -143,6 +149,16 @@ _TRANSFORMER_PRESETS = {
         d_model=512, n_layers=8, n_heads=4, d_ff=2048, vocab=8192,
         seq=8192, batch=2, flash=True, remat=False, scan_layers=False,
         metric="transformer_flash_8k_h128",
+    ),
+    "transformer-flash-32k": dict(
+        # the regime where dense attention cannot even compile (the
+        # (B, H, T, T) score tensor alone would be 8GB at B=1): the r4
+        # streamed-grid flash kernels with the long-T backward blocks
+        # (bwd 512/2048) are the only path. B=1 sizes the no-remat
+        # activation footprint to HBM; same h128 head geometry as 8k
+        d_model=512, n_layers=8, n_heads=4, d_ff=2048, vocab=8192,
+        seq=32768, batch=1, flash=True, remat=False, scan_layers=False,
+        metric="transformer_flash_32k_h128",
     ),
 }
 
@@ -468,7 +484,7 @@ def _verify_int8_decode() -> None:
 
 
 def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
-                  int8: bool = False):
+                  int8: bool = False, gqa: bool = False):
     """KV-cached autoregressive decode throughput on the GPT-2-small
     config: bulk prefill (512 tokens) + 64 sampled steps per call, all
     inside one jitted program. Reported rate counts only the NEW tokens
@@ -482,7 +498,11 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
     (per-output-channel scales, dequant fused into the matmul reads)
     plus an int8 KV cache with per-row scales dequantized in-register
     by the decode kernel — the two streams the decode wall analysis
-    (PERF.md) identifies as the bf16 floor."""
+    (PERF.md) identifies as the bf16 floor. ``gqa=True`` is the
+    production decode geometry (r5, VERDICT r4 #2): n_kv_heads=2 of 6
+    query heads (3x smaller KV cache and cache stream) + RoPE — same
+    d_model/d_head, so the non-attention work is identical to the MHA
+    twin and the delta isolates the cache-stream effect."""
     import functools
     import jax
     import jax.numpy as jnp
@@ -508,6 +528,8 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
         use_flash=flash,
         compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
         decode_int8=int8,
+        n_kv_heads=2 if gqa else None,
+        rope=gqa,
     )
     params = init_transformer(jax.random.key(0), cfg)
     if int8:
@@ -545,14 +567,24 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
     # credited (prefill time IS in the denominator: conservative).
     d, nl, ff, v = p["d_model"], p["n_layers"], p["d_ff"], p["vocab"]
     bpe = 2 if args.dtype == "bf16" else 4
-    matmul_params = nl * (4 * d * d + 2 * d * ff) + d * v
+    kv_heads = cfg.n_kv_heads or cfg.n_heads
+    # attention projections from the ACTUAL config: GQA's wkv is
+    # d x (2*kv_heads*head_dim), not the MHA 3*d*d — crediting MHA
+    # weights would inflate the GQA rows' MBU ~7%
+    attn_params = d * cfg.n_heads * cfg.head_dim * 2  # wq (or q of wqkv) + wo
+    attn_params += d * 2 * kv_heads * cfg.head_dim    # k and v projections
+    matmul_params = nl * (attn_params + 2 * d * ff) + d * v
     float_params = nl * (4 * d + ff + d)  # ln scales/biases + b1/b2
     avg_vis = prompt_len + (new + 1) / 2
-    kv_heads = cfg.n_kv_heads or cfg.n_heads
     if int8:
         # int8 matmul weights + their f32 per-output-channel scales +
         # the float leftovers; int8 cache rows + f32 per-row scales
-        scale_count = nl * (3 * d + d + ff + d) + v
+        attn_out_ch = (
+            cfg.n_heads * cfg.head_dim           # q output channels
+            + 2 * kv_heads * cfg.head_dim        # k/v output channels
+            + d                                  # wo output channels
+        )
+        scale_count = nl * (attn_out_ch + ff + d) + v
         weight_bytes = (
             matmul_params * 1 + scale_count * 4 + float_params * bpe
         )
@@ -655,8 +687,11 @@ def _build(model: str, batch: int):
 
 _ALL_WORKLOADS = (
     "lenet", "alexnet", "resnet", "word2vec", "transformer",
-    "transformer-flash-8k", "transformer-decode", "transformer-decode-b64",
+    "transformer-flash-8k", "transformer-flash-32k",
+    "transformer-decode", "transformer-decode-b64",
     "transformer-decode-int8", "transformer-decode-b64-int8",
+    "transformer-decode-gqa", "transformer-decode-gqa-b64",
+    "transformer-decode-gqa-b64-int8",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -666,8 +701,11 @@ _AUTO_DTYPE = {
     "lenet": "f32", "alexnet": "bf16", "resnet": "bf16",
     "word2vec": "f32",
     "transformer": "bf16", "transformer-flash-8k": "bf16",
+    "transformer-flash-32k": "bf16",
     "transformer-decode": "bf16", "transformer-decode-b64": "bf16",
     "transformer-decode-int8": "bf16", "transformer-decode-b64-int8": "bf16",
+    "transformer-decode-gqa": "bf16", "transformer-decode-gqa-b64": "bf16",
+    "transformer-decode-gqa-b64-int8": "bf16",
 }
 
 
@@ -778,20 +816,23 @@ def _run_one_inner(args, jax) -> None:
             raise SystemExit("--scaling does not apply to decode")
         int8 = args.model.endswith("int8")
         b64 = "-b64" in args.model
+        gqa = "-gqa" in args.model
+        suffix = (
+            ("_gqa" if gqa else "")
+            + ("_b64" if b64 else "")
+            + ("_int8" if int8 else "")
+        )
 
         def run_decode():
             v, _m, u = _bench_decode(
-                args, batch=64 if b64 else 16,
-                metric_suffix=("_b64" if b64 else "")
-                + ("_int8" if int8 else ""),
-                int8=int8,
+                args, batch=64 if b64 else 16, metric_suffix=suffix,
+                int8=int8, gqa=gqa,
             )
             return v, u
 
         per_chip, metric, mbu = _bench_decode(
-            args, batch=64 if b64 else 16,
-            metric_suffix=("_b64" if b64 else "") + ("_int8" if int8 else ""),
-            int8=int8,
+            args, batch=64 if b64 else 16, metric_suffix=suffix,
+            int8=int8, gqa=gqa,
         )
         _report(args, per_chip, metric, jax, util=mbu, util_key="mbu",
                 remeasure=run_decode)

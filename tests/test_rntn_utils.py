@@ -100,7 +100,11 @@ def test_rntn_per_label_tables_on_treebank():
     from deeplearning4j_tpu.models.rntn import _pack_full, basic_category
     from deeplearning4j_tpu.nlp.parser import bundled_treebank
 
-    trees = [binarize(t) for t in bundled_treebank()]
+    # the r5 treebank grew to 229 trees for parser coverage; this test's
+    # subject is the per-production TABLE mechanics, for which the first
+    # 40 trees already span the category variety — full-treebank
+    # training tripled the slow lane's longest test for no extra signal
+    trees = [binarize(t) for t in bundled_treebank()[:40]]
     cats = sorted(
         {basic_category(n.label, False) for t in trees for n in t.subtrees()}
     )

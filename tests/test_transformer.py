@@ -199,6 +199,7 @@ def test_greedy_generate_matches_full_forward():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.slow
 def test_int8_decode_quality_gate():
     """Weight-only int8 params + int8 KV cache (VERDICT r4 #1 quality
     gate): the quantized decode program must track the float reference —
