@@ -30,6 +30,11 @@ per workload — the driver's round record captures all of them:
                   production decode geometry (6 query heads over 2 KV
                   heads + RoPE): 3x smaller cache stream; the -int8
                   composite is the headline serving point
+- ``transformer-decode-gqa-int8w`` / ``-gqa-b64-int8w`` weight-only
+                  int8 over the bf16 GQA cache (the split PERF.md's r5
+                  crossover analysis predicts as the winning composite:
+                  halve the weight stream, keep the cheap bf16 cache
+                  kernel)
 - ``transformer-flash-32k`` long-context training at T=32768 (B=1) —
                   the regime where dense attention cannot compile
 
@@ -412,26 +417,33 @@ def _bench_transformer(args, preset_name: str):
     return tokens_per_sec, f"{p['metric']}_train_tokens_per_sec_per_chip", mfu
 
 
-_INT8_GATE_RAN = False
+_INT8_GATES_RAN = set()
 
 
-def _verify_int8_decode() -> None:
-    """On-TPU parity gate for the int8 serving path (weights + KV cache
-    quantized): greedy logits from the quantized program must stay
-    within a few percent of the bf16 reference on a small config before
-    any int8 throughput number is trusted. Mirrors the flash-grad gate:
-    interpret-mode CPU tests cannot observe device-side kernel drift.
-    Deterministic, so it runs once per process — remeasure attempts
-    must not re-pay its compile+run cost."""
+def _verify_int8_decode(weights_only: bool = False,
+                        gqa: bool = False) -> None:
+    """On-TPU parity gate for the int8 serving paths: greedy logits from
+    the quantized program must stay within a few percent of the bf16
+    reference on a small config before any int8 throughput number is
+    trusted. ``weights_only`` gates the int8-weights/bf16-cache split
+    (decode_int8 stays False — the bf16 kernel path reads dequantized
+    weights); default gates the fully-quantized path (weights + int8 KV
+    cache). ``gqa`` gates the grouped geometry (groups=3 + RoPE): the
+    rewritten kernel's wide-dot group batching is a distinct lowered
+    path from MHA's, so the GQA presets must not ride an MHA-only gate.
+    Mirrors the flash-grad gate: interpret-mode CPU tests cannot
+    observe device-side kernel drift. Deterministic, so each mode runs
+    once per process — remeasure attempts must not re-pay its
+    compile+run cost."""
     import dataclasses
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    global _INT8_GATE_RAN
-    if _INT8_GATE_RAN or jax.devices()[0].platform != "tpu":
+    key = (weights_only, gqa)
+    if key in _INT8_GATES_RAN or jax.devices()[0].platform != "tpu":
         return
-    _INT8_GATE_RAN = True
+    _INT8_GATES_RAN.add(key)
 
     from deeplearning4j_tpu.models.transformer import (
         TransformerConfig,
@@ -440,13 +452,18 @@ def _verify_int8_decode() -> None:
         quantize_decode_params,
     )
 
+    # the GQA gate runs the production group shape (6 heads over 2 KV
+    # heads, groups=3) so the kernel's grouped wide-dot path is the one
+    # being checked; d_model keeps head_dim integral (384/6 = 64)
     cfg = TransformerConfig(
-        vocab_size=256, d_model=256, n_heads=2, n_layers=2, d_ff=512,
-        max_len=160, compute_dtype=jnp.bfloat16,
+        vocab_size=256, d_model=384 if gqa else 256,
+        n_heads=6 if gqa else 2, n_kv_heads=2 if gqa else None,
+        rope=gqa, n_layers=2, d_ff=512, max_len=160,
+        compute_dtype=jnp.bfloat16,
     )
     params = init_transformer(jax.random.key(0), cfg)
     qparams = quantize_decode_params(params, cfg)
-    cfg_q = dataclasses.replace(cfg, decode_int8=True)
+    cfg_q = dataclasses.replace(cfg, decode_int8=not weights_only)
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, (4, 128)).astype(np.int32)
     )
@@ -463,7 +480,8 @@ def _verify_int8_decode() -> None:
                 # argmax tie-flip on near-uniform random-init logits
                 # would compare logits of two different contexts
                 tok = jnp.argmax(lg, -1).astype(jnp.int32)
-            lg2, _ = f1(cp(pp), caches, tok, 128)
+            # array pos: the RoPE tables index by the traced position
+            lg2, _ = f1(cp(pp), caches, tok, jnp.asarray(128))
             return lg, lg2, tok
 
         return run(prompt, tok)
@@ -476,15 +494,16 @@ def _verify_int8_decode() -> None:
         err = float(jnp.max(jnp.abs(a - b)))
         scale = float(jnp.max(jnp.abs(b)))
         if not err < 0.08 * scale + 0.02:
+            mode = "int8w" if weights_only else "int8"
             raise AssertionError(
-                f"int8 decode {name} logits diverge from bf16 "
+                f"{mode} decode {name} logits diverge from bf16 "
                 f"(max abs err {err:.3e}, scale {scale:.3e}) — do not "
-                "trust int8 serving numbers"
+                f"trust {mode} serving numbers"
             )
 
 
 def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
-                  int8: bool = False, gqa: bool = False):
+                  int8: str = "off", gqa: bool = False):
     """KV-cached autoregressive decode throughput on the GPT-2-small
     config: bulk prefill (512 tokens) + 64 sampled steps per call, all
     inside one jitted program. Reported rate counts only the NEW tokens
@@ -493,12 +512,16 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
 
     ``batch=16`` is the round-1 workload definition (latency-leaning);
     the ``-b64`` variant is the throughput-serving point, where the
-    weight stream amortizes over 4x the tokens. ``int8=True`` is the
-    production serving quantization (r5): weight-only int8 params
+    weight stream amortizes over 4x the tokens. ``int8="full"`` is the
+    fully-quantized serving path (r5): weight-only int8 params
     (per-output-channel scales, dequant fused into the matmul reads)
     plus an int8 KV cache with per-row scales dequantized in-register
     by the decode kernel — the two streams the decode wall analysis
-    (PERF.md) identifies as the bf16 floor. ``gqa=True`` is the
+    (PERF.md) identifies as the bf16 floor. ``int8="weights"`` is the
+    split composite that analysis predicts wins under GQA: int8 weights
+    over an untouched bf16 cache (the cache is already 3x smaller, so
+    the remaining win is the weight stream and the bf16 kernel stays on
+    its cheapest path). ``gqa=True`` is the
     production decode geometry (r5, VERDICT r4 #2): n_kv_heads=2 of 6
     query heads (3x smaller KV cache and cache stream) + RoPE — same
     d_model/d_head, so the non-attention work is identical to the MHA
@@ -527,13 +550,13 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
         # decode steps use the KV-cache path either way
         use_flash=flash,
         compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
-        decode_int8=int8,
+        decode_int8=(int8 == "full"),
         n_kv_heads=2 if gqa else None,
         rope=gqa,
     )
     params = init_transformer(jax.random.key(0), cfg)
-    if int8:
-        _verify_int8_decode()
+    if int8 != "off":
+        _verify_int8_decode(weights_only=(int8 == "weights"), gqa=gqa)
         params = quantize_decode_params(params, cfg)
     gen = jax.jit(
         functools.partial(
@@ -576,9 +599,9 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
     matmul_params = nl * (attn_params + 2 * d * ff) + d * v
     float_params = nl * (4 * d + ff + d)  # ln scales/biases + b1/b2
     avg_vis = prompt_len + (new + 1) / 2
-    if int8:
+    if int8 != "off":
         # int8 matmul weights + their f32 per-output-channel scales +
-        # the float leftovers; int8 cache rows + f32 per-row scales
+        # the float leftovers
         attn_out_ch = (
             cfg.n_heads * cfg.head_dim           # q output channels
             + 2 * kv_heads * cfg.head_dim        # k/v output channels
@@ -588,12 +611,16 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
         weight_bytes = (
             matmul_params * 1 + scale_count * 4 + float_params * bpe
         )
+    else:
+        weight_bytes = (matmul_params + float_params) * bpe
+    if int8 == "full":
+        # int8 cache rows + f32 per-row scales; "weights" mode keeps
+        # the cache at the compute dtype
         cache_bytes = (
             2 * batch * avg_vis * kv_heads * cfg.head_dim * 1 * nl
             + 2 * batch * avg_vis * 4 * nl
         )
     else:
-        weight_bytes = (matmul_params + float_params) * bpe
         cache_bytes = (
             2 * batch * avg_vis * kv_heads * cfg.head_dim * bpe * nl
         )
@@ -692,6 +719,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-int8", "transformer-decode-b64-int8",
     "transformer-decode-gqa", "transformer-decode-gqa-b64",
     "transformer-decode-gqa-b64-int8",
+    "transformer-decode-gqa-int8w", "transformer-decode-gqa-b64-int8w",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -706,6 +734,8 @@ _AUTO_DTYPE = {
     "transformer-decode-int8": "bf16", "transformer-decode-b64-int8": "bf16",
     "transformer-decode-gqa": "bf16", "transformer-decode-gqa-b64": "bf16",
     "transformer-decode-gqa-b64-int8": "bf16",
+    "transformer-decode-gqa-int8w": "bf16",
+    "transformer-decode-gqa-b64-int8w": "bf16",
 }
 
 
@@ -814,13 +844,17 @@ def _run_one_inner(args, jax) -> None:
     if args.model.startswith("transformer-decode"):
         if args.scaling:
             raise SystemExit("--scaling does not apply to decode")
-        int8 = args.model.endswith("int8")
+        int8 = (
+            "weights" if args.model.endswith("int8w")
+            else "full" if args.model.endswith("int8")
+            else "off"
+        )
         b64 = "-b64" in args.model
         gqa = "-gqa" in args.model
         suffix = (
             ("_gqa" if gqa else "")
             + ("_b64" if b64 else "")
-            + ("_int8" if int8 else "")
+            + {"off": "", "full": "_int8", "weights": "_int8w"}[int8]
         )
 
         def run_decode():

@@ -202,7 +202,13 @@ def _train_transformer(args) -> int:
                 print("early stop triggered")
                 break
         if mgr:
-            mgr.maybe_save(i + 1, params, {"loss": loss})
+            # the config rides in the meta so `generate` can rebuild the
+            # restore template without re-plumbing the model flags
+            # (≙ the reference persisting json config WITH the params —
+            # MultiLayerConfiguration.toJson:125)
+            mgr.maybe_save(
+                i + 1, params, {"loss": loss, "config": cfg.to_json()}
+            )
     if mgr is not None and hasattr(mgr, "wait"):
         mgr.wait()  # async saves must be durable before exit
     if loss is None and l is not None:
@@ -284,6 +290,120 @@ def cmd_train(args) -> int:
                 mgr.maybe_save(step_idx, state.params, {"loss": float(loss)})
     svc.phase = "done"
     print(f"final loss {float(loss):.4f}")
+    return 0
+
+
+def cmd_generate(args) -> int:
+    """Serve a trained transformer checkpoint: restore the params
+    (npz or orbax backend), optionally quantize for int8 serving, and
+    sample a continuation of --prompt (byte-level, matching train).
+
+    ≙ the reference's sampling entry points (LSTM.java:219 sampleDoc /
+    the char-RNN demo) as a standalone serving command; the int8 modes
+    are the PERF.md r5 production quantization."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+        quantize_decode_params,
+        transformer_beam_search,
+        transformer_generate,
+    )
+
+    import dataclasses
+    from pathlib import Path
+
+    # a read-only command must not mkdir its way past a typo'd path
+    # (both managers create their directory tree on construction)
+    if not Path(args.checkpoint_dir).is_dir():
+        print(f"no checkpoint found in {args.checkpoint_dir}",
+              file=sys.stderr)
+        return 1
+    if args.checkpoint_backend == "orbax":
+        from deeplearning4j_tpu.parallel.checkpoint import (
+            AsyncShardedCheckpointManager,
+        )
+
+        mgr = AsyncShardedCheckpointManager(args.checkpoint_dir)
+    else:
+        from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(args.checkpoint_dir)
+    try:
+        meta0 = mgr.read_meta()
+        if meta0 is None:
+            print(
+                f"no checkpoint found in {args.checkpoint_dir}",
+                file=sys.stderr,
+            )
+            return 1
+        if "config" in meta0:
+            # trained config rides in the checkpoint meta — the model
+            # flags are not needed (and not trusted) for the template
+            cfg = TransformerConfig.from_json(meta0["config"])
+        else:
+            # pre-config checkpoint: fall back to the model flags, which
+            # MUST match the train invocation's (shape errors otherwise)
+            cfg = TransformerConfig(
+                vocab_size=256,
+                d_model=args.d_model,
+                n_heads=args.n_heads,
+                n_layers=args.n_layers,
+                d_ff=4 * args.d_model,
+                max_len=args.seq_len + 1,
+                n_experts=args.n_experts,
+                compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            )
+        if args.int8 != "off" and cfg.n_experts:
+            print("--int8 does not cover MoE experts", file=sys.stderr)
+            return 2
+        cfg = dataclasses.replace(cfg, decode_int8=(args.int8 == "full"))
+        template = init_transformer(jax.random.key(0), cfg)
+        res = mgr.restore_latest(template)
+    finally:
+        if hasattr(mgr, "close"):
+            mgr.close()
+    if res is None:
+        print(f"no checkpoint found in {args.checkpoint_dir}", file=sys.stderr)
+        return 1
+    params, meta = res
+    print(f"restored step {meta.get('step')} from {args.checkpoint_dir}")
+    if args.int8 != "off":
+        params = quantize_decode_params(params, cfg)
+        print(f"int8 serving mode: {args.int8} "
+              f"({'weights + kv cache' if args.int8 == 'full' else 'weights over a bf16/f32 cache'})")
+
+    prompt_bytes = args.prompt.encode("latin-1", errors="replace")
+    room = cfg.max_len - len(prompt_bytes)
+    if room <= 0:
+        print(f"--prompt is {len(prompt_bytes)} bytes; max_len "
+              f"({cfg.max_len}) leaves no room to decode", file=sys.stderr)
+        return 2
+    max_new = min(args.max_new, room)
+    prompt = jnp.asarray(
+        np.frombuffer(prompt_bytes, np.uint8).astype(np.int32)[None, :]
+    )
+    if args.beam:
+        beam = transformer_beam_search(cfg)
+        toks, scores = beam(
+            params, prompt, beam_width=args.beam, max_new=max_new
+        )
+        for w in range(args.beam):
+            text = bytes(np.asarray(toks[0, w], np.uint8).tolist())
+            print(f"beam {w} (logp {float(scores[0, w]):.2f}):",
+                  text.decode("latin-1"))
+    else:
+        gen = transformer_generate(cfg)
+        out = gen(
+            params, prompt, jax.random.key(args.seed), max_new,
+            temperature=args.temperature,
+            top_k=args.top_k if args.top_k > 0 else None,
+        )
+        text = bytes(np.asarray(out[0], np.uint8).tolist())
+        print("sample:", text.decode("latin-1"))
     return 0
 
 
@@ -399,6 +519,38 @@ def main(argv: list[str] | None = None) -> int:
     )
     _add_distributed_flags(t)
     t.set_defaults(fn=cmd_train)
+
+    g = sub.add_parser(
+        "generate",
+        help="sample from a trained transformer checkpoint "
+        "(byte-level; --int8 weights|full for quantized serving)",
+    )
+    g.add_argument("--checkpoint-dir", required=True)
+    g.add_argument(
+        "--checkpoint-backend", default="npz", choices=["npz", "orbax"],
+    )
+    g.add_argument("--prompt", default="the quick brown ")
+    g.add_argument("--max-new", type=int, default=48)
+    g.add_argument("--temperature", type=float, default=0.8)
+    g.add_argument("--top-k", type=int, default=40,
+                   help="0 disables top-k filtering")
+    g.add_argument("--beam", type=int, default=0,
+                   help="beam width; 0 = sampled decode")
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument(
+        "--int8", default="off", choices=["off", "weights", "full"],
+        help="weight-only int8 (over a float cache) or the fully "
+        "quantized path (int8 KV cache too) — PERF.md r5",
+    )
+    # model flags: fallback ONLY for checkpoints saved before the config
+    # rode in the meta — then they must match the train invocation
+    g.add_argument("--seq-len", type=int, default=128)
+    g.add_argument("--d-model", type=int, default=128)
+    g.add_argument("--n-layers", type=int, default=2)
+    g.add_argument("--n-heads", type=int, default=4)
+    g.add_argument("--n-experts", type=int, default=0)
+    g.add_argument("--bf16", action="store_true")
+    g.set_defaults(fn=cmd_generate)
 
     # add_help=False so `bench -h` reaches bench.py's parser, which
     # documents --model/--batch/--dtype

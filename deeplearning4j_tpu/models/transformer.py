@@ -219,7 +219,14 @@ def quantize_decode_params(params, cfg: TransformerConfig):
     norm scales/biases stay float. Decode paths dequantize inside the
     jitted program — XLA fuses the int8 read + convert + scale into the
     matmul operand, so the per-step HBM weight stream halves vs bf16.
-    Pair with ``dataclasses.replace(cfg, decode_int8=True)``.
+    Pair with ``dataclasses.replace(cfg, decode_int8=True)`` for the
+    fully-quantized path (int8 KV cache + int8 kernel). Leaving
+    ``decode_int8=False`` with quantized params is the supported
+    weight-only split: ``_w`` dequantizes int8 leaves by dtype, the KV
+    cache stays at the compute dtype and the bf16 decode kernel runs
+    unchanged — the winning composite under GQA, where the cache is
+    already 3x smaller and the weight stream dominates (PERF.md r5
+    crossover analysis).
     """
     if cfg.n_experts:
         raise NotImplementedError(
@@ -316,10 +323,44 @@ def transformer_shardings(mesh: Mesh, cfg: TransformerConfig | None = None):
     }
 
 
+def _quantized_leaf_sharding(mesh: Mesh, weight_sharding, axes):
+    """Sharding for an int8 leaf's per-channel scale: the weight's spec
+    with the quantized (size-1 keepdims) axes unsharded. Scales are
+    computed over the FULL reduction axis before placement, so a scale
+    whose weight is sharded along that axis is a single global value —
+    replicated there by construction."""
+    spec = list(weight_sharding.spec)
+    for ax in axes:
+        if ax < len(spec):
+            spec[ax] = None
+    return NamedSharding(mesh, P(*spec))
+
+
 def place_transformer_params(mesh: Mesh, params, cfg=None):
-    return jax.tree.map(
-        mesh_lib.place_global, params, transformer_shardings(mesh, cfg)
-    )
+    """Place a params pytree (float or int8-quantized serving params)
+    with the Megatron layout. Quantized pytrees (extra ``name_scale``
+    leaves from :func:`quantize_decode_params`) get scale shardings
+    derived from their weight's spec, so int8 serving runs under the
+    same dp x tp mesh as bf16."""
+    shardings = transformer_shardings(mesh, cfg)
+    blocks = params["blocks"]
+    if any(
+        name in blocks and blocks[name].dtype == jnp.int8
+        for name in _INT8_BLOCK_AXES
+    ):
+        sblocks = dict(shardings["blocks"])
+        for name, axes in _INT8_BLOCK_AXES.items():
+            if name + "_scale" in blocks:
+                sblocks[name + "_scale"] = _quantized_leaf_sharding(
+                    mesh, sblocks[name], axes
+                )
+        shardings = dict(shardings)
+        shardings["blocks"] = sblocks
+        if "head_scale" in params:
+            shardings["head_scale"] = _quantized_leaf_sharding(
+                mesh, shardings["head"], (0,)
+            )
+    return jax.tree.map(mesh_lib.place_global, params, shardings)
 
 
 def _layer_norm(x, scale, bias, eps=1e-5):

@@ -127,6 +127,18 @@ class CheckpointManager:
             return None
         return restore(self.directory / f"ckpt_{s}.npz", like)
 
+    def read_meta(self) -> dict | None:
+        """The latest checkpoint's meta dict WITHOUT a params template —
+        lets a consumer (e.g. ``cli.py generate``) discover the saved
+        model config before it can build the restore template."""
+        s = self.latest_step()
+        if s is None:
+            return None
+        with np.load(
+            self.directory / f"ckpt_{s}.npz", allow_pickle=False
+        ) as z:
+            return json.loads(str(z["__manifest__"]))["meta"]
+
 
 class AsyncShardedCheckpointManager:
     """Orbax-backed manager for sharded params — the multi-host path.
@@ -194,6 +206,17 @@ class AsyncShardedCheckpointManager:
             ),
         )
         return out["state"], dict(out["meta"])
+
+    def read_meta(self) -> dict | None:
+        """Meta alone (no params template) — see CheckpointManager.read_meta."""
+        s = self.latest_step()
+        if s is None:
+            return None
+        ocp = self._ocp
+        out = self._mngr.restore(
+            s, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )
+        return dict(out["meta"])
 
     def close(self) -> None:
         self._mngr.close()

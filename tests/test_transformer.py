@@ -281,6 +281,95 @@ def test_int8_decode_quality_gate():
 
 
 @pytest.mark.slow
+def test_int8_weights_only_decode_over_bf16_cache():
+    """The int8-weights/bf16-cache split (PERF.md r5 crossover: the
+    winning composite under GQA): quantized params with
+    ``decode_int8=False`` must run the unmodified bf16 cache/kernel path
+    — ``_w`` dequantizes by leaf dtype — and track the float reference
+    as closely as the fully-quantized path does."""
+    import dataclasses
+    import functools
+
+    from deeplearning4j_tpu.models.transformer import (
+        _decode_builder,
+        quantize_decode_params,
+        transformer_generate,
+    )
+
+    # production geometry: GQA (2 kv heads under 4 query heads) + RoPE
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=96, n_kv_heads=2, rope=True,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    qparams = quantize_decode_params(params, cfg)  # cfg keeps decode_int8=False
+
+    prompt = _tokens(4, 24, seed=7)
+    f1, ic, pf, cp = _decode_builder(cfg)
+    # same builder for both: only the params differ
+    caches, lg = pf(cp(params), ic(4, 40), prompt)
+    caches_q, lgq = pf(cp(qparams), ic(4, 40), prompt)
+    # the bf16 cache is shared infrastructure: identical dtype/shape
+    assert caches_q.dtype == caches.dtype and caches_q.shape == caches.shape
+    scale = float(jnp.max(jnp.abs(lg)))
+    assert float(jnp.max(jnp.abs(lgq - lg))) < 0.06 * scale + 0.02
+    tok = jnp.argmax(lg, -1).astype(jnp.int32)
+    pos = jnp.asarray(24)  # array: the RoPE tables index by traced pos
+    l2, _ = f1(cp(params), caches, tok, pos)
+    l2q, _ = f1(cp(qparams), caches_q, tok, pos)
+    scale2 = float(jnp.max(jnp.abs(l2)))
+    assert float(jnp.max(jnp.abs(l2q - l2))) < 0.06 * scale2 + 0.02
+
+    # greedy decode through the full generate program
+    gen = jax.jit(functools.partial(
+        transformer_generate(cfg), max_new=16, temperature=0.0
+    ))
+    out = np.asarray(gen(params, prompt, jax.random.key(1)))
+    out_q = np.asarray(gen(qparams, prompt, jax.random.key(1)))
+    assert (out[:, 24:] == out_q[:, 24:]).mean() >= 0.7
+
+
+@pytest.mark.slow
+def test_int8_weights_decode_under_dp_tp_mesh():
+    """int8-weight serving partitioned by GSPMD: quantized params placed
+    with the Megatron layout (scale leaves derive their sharding from
+    their weight's spec, unsharding the size-1 quantized axes) must
+    decode on the dp x tp mesh and track the bf16 sharded run."""
+    import functools
+
+    from deeplearning4j_tpu.models.transformer import (
+        quantize_decode_params,
+        transformer_generate,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=96, n_kv_heads=2, rope=True,
+    )
+    mesh = mesh_lib.dp_mp_mesh(4, 2)
+    params = init_transformer(jax.random.key(0), cfg)
+    qparams = quantize_decode_params(params, cfg)
+    gp = place_transformer_params(mesh, params, cfg)
+    qp = place_transformer_params(mesh, qparams, cfg)
+    # row-parallel weights quantize over their sharded input axis: the
+    # (global, keepdims) scale must come out replicated on that axis
+    assert all(
+        s is None for s in qp["blocks"]["wo_scale"].sharding.spec
+    )
+    # column-parallel scales keep their weight's surviving sharded axis
+    assert qp["blocks"]["w1_scale"].sharding.spec[-1] is not None
+
+    prompt = _tokens(4, 24, seed=7)
+    gen = jax.jit(functools.partial(
+        transformer_generate(cfg), max_new=8, temperature=0.0
+    ))
+    out = np.asarray(gen(gp, prompt, jax.random.key(1)))
+    out_q = np.asarray(gen(qp, prompt, jax.random.key(1)))
+    assert ((out_q >= 0) & (out_q < cfg.vocab_size)).all()
+    assert (out[:, 24:] == out_q[:, 24:]).mean() >= 0.5
+
+
+@pytest.mark.slow
 def test_sampled_generate_is_deterministic_per_key_and_respects_top_k():
     from deeplearning4j_tpu.models.transformer import transformer_generate
 

@@ -104,6 +104,31 @@ def test_cli_train_transformer_tp_orbax(tmp_path, capsys):
     )
     assert steps == [1, 2, 4]
 
+    # the serving command restores the same checkpoint and samples —
+    # plain, int8-weights quantized, and beam decode. NO model flags:
+    # the trained config rides in the checkpoint meta
+    common = [
+        "generate", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-backend", "orbax",
+        "--prompt", "the quick", "--max-new", "8",
+    ]
+    assert main(common) == 0
+    out = capsys.readouterr().out
+    assert "restored step 4" in out and "sample: the quick" in out
+    assert main(common + ["--int8", "weights"]) == 0
+    out = capsys.readouterr().out
+    assert "int8 serving mode: weights" in out and "sample: the quick" in out
+    assert main(common + ["--beam", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "beam 0 (logp " in out and "beam 1 (logp " in out
+
+    # a missing checkpoint fails cleanly, not with a traceback — and the
+    # read-only command must not create the typo'd directory tree
+    assert main(
+        ["generate", "--checkpoint-dir", str(tmp_path / "empty")]
+    ) == 1
+    assert not (tmp_path / "empty").exists()
+
 
 def test_cloud_io_local_and_dispatch(tmp_path):
     saver = get_saver(str(tmp_path))
