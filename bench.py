@@ -35,6 +35,11 @@ per workload — the driver's round record captures all of them:
                   crossover analysis predicts as the winning composite:
                   halve the weight stream, keep the cheap bf16 cache
                   kernel)
+- ``transformer-decode-gqa-b1`` / ``-gqa-b1-int8w`` the interactive-
+                  latency point (batch 1): the step is almost purely the
+                  weight stream, so this row isolates what quantization
+                  buys a single-user session (and is the regime a future
+                  speculative-decode lever would target)
 - ``transformer-flash-32k`` long-context training at T=32768 (B=1) —
                   the regime where dense attention cannot compile
 
@@ -480,8 +485,7 @@ def _verify_int8_decode(weights_only: bool = False,
                 # argmax tie-flip on near-uniform random-init logits
                 # would compare logits of two different contexts
                 tok = jnp.argmax(lg, -1).astype(jnp.int32)
-            # array pos: the RoPE tables index by the traced position
-            lg2, _ = f1(cp(pp), caches, tok, jnp.asarray(128))
+            lg2, _ = f1(cp(pp), caches, tok, 128)
             return lg, lg2, tok
 
         return run(prompt, tok)
@@ -720,6 +724,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa", "transformer-decode-gqa-b64",
     "transformer-decode-gqa-b64-int8",
     "transformer-decode-gqa-int8w", "transformer-decode-gqa-b64-int8w",
+    "transformer-decode-gqa-b1", "transformer-decode-gqa-b1-int8w",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -736,6 +741,8 @@ _AUTO_DTYPE = {
     "transformer-decode-gqa-b64-int8": "bf16",
     "transformer-decode-gqa-int8w": "bf16",
     "transformer-decode-gqa-b64-int8w": "bf16",
+    "transformer-decode-gqa-b1": "bf16",
+    "transformer-decode-gqa-b1-int8w": "bf16",
 }
 
 
@@ -850,22 +857,24 @@ def _run_one_inner(args, jax) -> None:
             else "off"
         )
         b64 = "-b64" in args.model
+        b1 = "-b1" in args.model
         gqa = "-gqa" in args.model
+        batch = 64 if b64 else 1 if b1 else 16
         suffix = (
             ("_gqa" if gqa else "")
-            + ("_b64" if b64 else "")
+            + ("_b64" if b64 else "_b1" if b1 else "")
             + {"off": "", "full": "_int8", "weights": "_int8w"}[int8]
         )
 
         def run_decode():
             v, _m, u = _bench_decode(
-                args, batch=64 if b64 else 16, metric_suffix=suffix,
+                args, batch=batch, metric_suffix=suffix,
                 int8=int8, gqa=gqa,
             )
             return v, u
 
         per_chip, metric, mbu = _bench_decode(
-            args, batch=64 if b64 else 16, metric_suffix=suffix,
+            args, batch=batch, metric_suffix=suffix,
             int8=int8, gqa=gqa,
         )
         _report(args, per_chip, metric, jax, util=mbu, util_key="mbu",

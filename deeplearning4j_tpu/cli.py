@@ -53,6 +53,29 @@ def _start_status_rest(svc, args) -> None:
             )
 
 
+def _transformer_cfg_from_args(args):
+    """ONE flags->TransformerConfig recipe shared by train and the
+    generate fallback — if the train-side conventions (byte vocab,
+    d_ff=4*d_model, max_len=seq_len+1) ever change, pre-config
+    checkpoint restore must change with them, not silently diverge."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=256,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        d_ff=4 * args.d_model,
+        max_len=args.seq_len + 1,
+        n_experts=args.n_experts,
+        use_flash=getattr(args, "flash", False),
+        remat=getattr(args, "remat", False),
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+
+
 def _train_transformer(args) -> int:
     """Byte-level char-LM training for the flagship transformer: composed
     dp x tp mesh (``--tp``), optional MoE experts / FSDP, checkpointing via
@@ -112,18 +135,7 @@ def _train_transformer(args) -> int:
     n_dev = len(jax.devices())
     dp = max(1, n_dev // tp)
     mesh = mesh_lib.dp_mp_mesh(dp, tp)
-    cfg = TransformerConfig(
-        vocab_size=256,
-        d_model=args.d_model,
-        n_heads=args.n_heads,
-        n_layers=args.n_layers,
-        d_ff=4 * args.d_model,
-        max_len=args.seq_len + 1,
-        n_experts=args.n_experts,
-        use_flash=args.flash,
-        remat=args.remat,
-        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-    )
+    cfg = _transformer_cfg_from_args(args)
     step, init_state, shard_tokens = transformer_train_step(
         mesh, cfg,
         optimizer=lm_optimizer(total_steps=args.steps),
@@ -347,16 +359,7 @@ def cmd_generate(args) -> int:
         else:
             # pre-config checkpoint: fall back to the model flags, which
             # MUST match the train invocation's (shape errors otherwise)
-            cfg = TransformerConfig(
-                vocab_size=256,
-                d_model=args.d_model,
-                n_heads=args.n_heads,
-                n_layers=args.n_layers,
-                d_ff=4 * args.d_model,
-                max_len=args.seq_len + 1,
-                n_experts=args.n_experts,
-                compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-            )
+            cfg = _transformer_cfg_from_args(args)
         if args.int8 != "off" and cfg.n_experts:
             print("--int8 does not cover MoE experts", file=sys.stderr)
             return 2

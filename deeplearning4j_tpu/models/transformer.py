@@ -373,6 +373,7 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 
 def _rope_tables(positions, head_dim: int, dtype, base: float = 10000.0):
     """(cos, sin) tables for RoPE at the given positions: (..., head_dim/2)."""
+    positions = jnp.asarray(positions)  # accept plain int positions
     half = head_dim // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
     ang = positions[..., None].astype(jnp.float32) * freqs  # (..., half)

@@ -58,6 +58,19 @@ def main():
               temperature=0.8, top_k=20)
     print("sample:", bytes(np.asarray(out[0], np.uint8).tolist()).decode("latin-1"))
 
+    # int8 serving: weight-only quantization (per-channel scales, dequant
+    # fused into the matmul reads) over the float KV cache — the winning
+    # production composite on TPU (PERF.md r5 crossover analysis)
+    from deeplearning4j_tpu.models.transformer import quantize_decode_params
+
+    qparams = quantize_decode_params(params, cfg)
+    out_q = gen(qparams, jnp.asarray(arr[None, :16]), jax.random.key(1), 64,
+                temperature=0.8, top_k=20)
+    print(
+        "int8 sample:",
+        bytes(np.asarray(out_q[0], np.uint8).tolist()).decode("latin-1"),
+    )
+
 
 if __name__ == "__main__":
     main()

@@ -314,9 +314,8 @@ def test_int8_weights_only_decode_over_bf16_cache():
     scale = float(jnp.max(jnp.abs(lg)))
     assert float(jnp.max(jnp.abs(lgq - lg))) < 0.06 * scale + 0.02
     tok = jnp.argmax(lg, -1).astype(jnp.int32)
-    pos = jnp.asarray(24)  # array: the RoPE tables index by traced pos
-    l2, _ = f1(cp(params), caches, tok, pos)
-    l2q, _ = f1(cp(qparams), caches_q, tok, pos)
+    l2, _ = f1(cp(params), caches, tok, 24)
+    l2q, _ = f1(cp(qparams), caches_q, tok, 24)
     scale2 = float(jnp.max(jnp.abs(l2)))
     assert float(jnp.max(jnp.abs(l2q - l2))) < 0.06 * scale2 + 0.02
 
