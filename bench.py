@@ -38,8 +38,14 @@ per workload — the driver's round record captures all of them:
 - ``transformer-decode-gqa-b1`` / ``-gqa-b1-int8w`` the interactive-
                   latency point (batch 1): the step is almost purely the
                   weight stream, so this row isolates what quantization
-                  buys a single-user session (and is the regime a future
-                  speculative-decode lever would target)
+                  buys a single-user session
+- ``transformer-decode-gqa-b1-spec`` speculative decoding at B=1:
+                  the int8w-quantized self drafts k tokens, the bf16
+                  target verifies them in one chunked forward, rejection
+                  sampling keeps the output a bf16-target-distribution
+                  sample (exact w.r.t. the verify program — see the
+                  model docstring) — the distribution-preserving
+                  version of the int8w latency win
 - ``transformer-flash-32k`` long-context training at T=32768 (B=1) —
                   the regime where dense attention cannot compile
 
@@ -506,6 +512,45 @@ def _verify_int8_decode(weights_only: bool = False,
             )
 
 
+#: serving bench geometry: bulk prefill + sampled decode steps per call
+_DECODE_PROMPT_LEN, _DECODE_NEW = 512, 64
+
+
+def _decode_bench_cfg(args, batch: int, gqa: bool, int8: str = "off"):
+    """ONE construction of the serving-bench model config + prompt,
+    shared by the plain/int8 decode rows and the speculative row — so
+    the spec row measures exactly the geometry of the rows it is
+    documented as directly comparable to (a drift here would silently
+    compare different models). Returns (cfg, prompt, preset)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+
+    p = _TRANSFORMER_PRESETS["transformer"]
+    flash = p["flash"] if args.flash is None else args.flash
+    cfg = TransformerConfig(
+        vocab_size=p["vocab"], d_model=p["d_model"], n_heads=p["n_heads"],
+        n_layers=p["n_layers"], d_ff=p["d_ff"],
+        max_len=_DECODE_PROMPT_LEN + _DECODE_NEW + 1,
+        # flash is honored by the bulk-prefill path (the 512-token
+        # prompt satisfies the kernel's alignment); the per-token
+        # decode steps use the KV-cache path either way
+        use_flash=flash,
+        compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+        decode_int8=(int8 == "full"),
+        n_kv_heads=2 if gqa else None,
+        rope=gqa,
+    )
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, p["vocab"], (batch, _DECODE_PROMPT_LEN)).astype(
+            np.int32
+        )
+    )
+    return cfg, prompt, p
+
+
 def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
                   int8: str = "off", gqa: bool = False):
     """KV-cached autoregressive decode throughput on the GPT-2-small
@@ -532,32 +577,16 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
     twin and the delta isolates the cache-stream effect."""
     import functools
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from deeplearning4j_tpu.models.transformer import (
-        TransformerConfig,
         init_transformer,
         quantize_decode_params,
         transformer_generate,
     )
 
-    p = _TRANSFORMER_PRESETS["transformer"]
-    prompt_len, new = 512, 64
-    flash = p["flash"] if args.flash is None else args.flash
-    cfg = TransformerConfig(
-        vocab_size=p["vocab"], d_model=p["d_model"], n_heads=p["n_heads"],
-        n_layers=p["n_layers"], d_ff=p["d_ff"],
-        max_len=prompt_len + new + 1,
-        # flash is honored by the bulk-prefill path (the 512-token
-        # prompt satisfies the kernel's %128 constraint); the per-token
-        # decode steps use the KV-cache path either way
-        use_flash=flash,
-        compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
-        decode_int8=(int8 == "full"),
-        n_kv_heads=2 if gqa else None,
-        rope=gqa,
-    )
+    prompt_len, new = _DECODE_PROMPT_LEN, _DECODE_NEW
+    cfg, prompt, p = _decode_bench_cfg(args, batch, gqa, int8)
     params = init_transformer(jax.random.key(0), cfg)
     if int8 != "off":
         _verify_int8_decode(weights_only=(int8 == "weights"), gqa=gqa)
@@ -571,10 +600,6 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
             # the two are separable (PERF.md records both).
             top_k=40, approx_top_k=not args.exact_top_k,
         )
-    )
-    rng = np.random.default_rng(0)
-    prompt = jnp.asarray(
-        rng.integers(0, p["vocab"], (batch, prompt_len)).astype(np.int32)
     )
     holder = {"out": None}
 
@@ -638,6 +663,55 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
         tok_per_sec,
         f"transformer_gpt2s_h128_decode{metric_suffix}_tokens_per_sec_per_chip",
         mbu,
+    )
+
+
+def _bench_decode_spec(args):
+    """Speculative decode at the B=1 latency point: the GQA bf16 target
+    verifies k=4 tokens drafted by its own weight-only-int8 quantization
+    — output samples the bf16 (top-40, T=1) target distribution (exact
+    w.r.t. the verify program; see transformer_speculative_generate's
+    docstring for the float-reassociation caveat), so this row is
+    directly comparable to ``transformer-decode-gqa-b1`` (the plain
+    bf16 baseline) rather than to the lossy int8w row.
+    Acceptance is near-1 because draft≈target; the win is bounded by
+    draft-step cost (~the int8w step) + one chunked verify per round."""
+    import functools
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import (
+        init_transformer,
+        quantize_decode_params,
+        transformer_speculative_generate,
+    )
+
+    new, k = _DECODE_NEW, 4
+    cfg, prompt, p = _decode_bench_cfg(args, batch=1, gqa=True)
+    params = init_transformer(jax.random.key(0), cfg)
+    _verify_int8_decode(weights_only=True, gqa=True)
+    qdraft = quantize_decode_params(params, cfg)
+    gen = jax.jit(
+        functools.partial(
+            transformer_speculative_generate(cfg), max_new=new,
+            draft_k=k, temperature=1.0, top_k=40,
+            approx_top_k=not args.exact_top_k,
+        )
+    )
+    holder = {"out": None}
+
+    def run(i):
+        holder["out"] = gen(params, qdraft, prompt, jax.random.key(i))
+
+    def drain():
+        out = np.asarray(holder["out"][:, -1])
+        assert ((out >= 0) & (out < p["vocab"])).all()
+
+    reps, dt = _run_window(args, run, drain, min_reps=5)
+    tok_per_sec = new * reps / dt
+    return (
+        tok_per_sec,
+        "transformer_gpt2s_h128_decode_gqa_b1_spec_tokens_per_sec_per_chip",
     )
 
 
@@ -725,6 +799,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-b64-int8",
     "transformer-decode-gqa-int8w", "transformer-decode-gqa-b64-int8w",
     "transformer-decode-gqa-b1", "transformer-decode-gqa-b1-int8w",
+    "transformer-decode-gqa-b1-spec",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -743,6 +818,7 @@ _AUTO_DTYPE = {
     "transformer-decode-gqa-b64-int8w": "bf16",
     "transformer-decode-gqa-b1": "bf16",
     "transformer-decode-gqa-b1-int8w": "bf16",
+    "transformer-decode-gqa-b1-spec": "bf16",
 }
 
 
@@ -851,6 +927,11 @@ def _run_one_inner(args, jax) -> None:
     if args.model.startswith("transformer-decode"):
         if args.scaling:
             raise SystemExit("--scaling does not apply to decode")
+        if args.model.endswith("-spec"):
+            per_chip, metric = _bench_decode_spec(args)
+            _report(args, per_chip, metric, jax,
+                    remeasure=lambda: (_bench_decode_spec(args)[0], None))
+            return
         int8 = (
             "weights" if args.model.endswith("int8w")
             else "full" if args.model.endswith("int8")

@@ -713,6 +713,13 @@ def _decode_builder(cfg: TransformerConfig):
         # streams 2.67x the logical bytes every step (601us/step for the
         # QK read alone, measured r2). Under GQA the cache holds only
         # kv_heads — the memory win.
+        if not cfg.decode_kernel:
+            # the dense fallback IS the C=1 chunk block — one code path
+            # (no separate copy to drift), used under SPMD sharding,
+            # for debugging, and as speculative decoding's
+            # numerics-matched draft mode
+            y, kv_all = _block_chunk(cfg, x[:, None, :], p, kv_all, i, pos)
+            return y[:, 0], kv_all
         b = x.shape[0]
         kd = cfg.head_dim
         grp = cfg.n_heads // cfg.kv_heads
@@ -749,52 +756,29 @@ def _decode_builder(cfg: TransformerConfig):
                 kv_buf, kv_row.astype(kv_buf.dtype), (i, 0, 0, pos, 0)
             )
             kv_all = kv_buf
-        if cfg.decode_kernel:
-            from deeplearning4j_tpu.ops.pallas_kernels import (
-                flash_decode_attention,
-            )
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            flash_decode_attention,
+        )
 
-            # query head h = kv*G + g (the _expand_kv repeat order):
-            # group into (B, G, Hkv*K) so each group is packed head-major
-            qp = (
-                q.reshape(b, cfg.kv_heads, grp, kd)
-                .transpose(0, 2, 1, 3)
-                .reshape(b, grp, cfg.kv_heads * kd)
-            )
-            # the kernel takes the STACKED cache and selects the (static)
-            # layer in its index map — slicing here would materialize a
-            # full-cache copy per layer (custom calls need dense operands)
-            o = flash_decode_attention(
-                qp, kv_buf, pos, n_kv_heads=cfg.kv_heads, layer=i,
-                kv_scales=sc_buf,
-            )
-            o_flat = (
-                o.reshape(b, grp, cfg.kv_heads, kd)
-                .transpose(0, 2, 1, 3)
-                .reshape(b, cfg.n_heads * kd)
-            )
-        else:
-            if cfg.decode_int8:
-                # dense fallback dequantizes the whole visible cache —
-                # debugging path only; the kernel path dequantizes
-                # in-register
-                ck = (kv_buf[i, 0].astype(jnp.float32)
-                      * sc_buf[i, 0]).astype(x.dtype)
-                cv = (kv_buf[i, 1].astype(jnp.float32)
-                      * sc_buf[i, 1]).astype(x.dtype)
-            else:
-                ck, cv = kv_buf[i, 0], kv_buf[i, 1]
-            ck4 = ck.reshape(b, -1, cfg.kv_heads, kd)
-            cv4 = cv.reshape(b, -1, cfg.kv_heads, kd)
-            qg = q.reshape(b, cfg.kv_heads, grp, kd)
-            logits = jnp.einsum(
-                "bhgk,bthk->bhgt", qg, ck4
-            ) / jnp.sqrt(kd).astype(x.dtype)
-            mask = (jnp.arange(ck4.shape[1]) <= pos)[None, None, None, :]
-            logits = jnp.where(mask, logits, -jnp.inf)
-            w = jax.nn.softmax(logits, axis=-1)
-            o = jnp.einsum("bhgt,bthk->bhgk", w, cv4)
-            o_flat = o.reshape(b, cfg.n_heads * kd)
+        # query head h = kv*G + g (the _expand_kv repeat order):
+        # group into (B, G, Hkv*K) so each group is packed head-major
+        qp = (
+            q.reshape(b, cfg.kv_heads, grp, kd)
+            .transpose(0, 2, 1, 3)
+            .reshape(b, grp, cfg.kv_heads * kd)
+        )
+        # the kernel takes the STACKED cache and selects the (static)
+        # layer in its index map — slicing here would materialize a
+        # full-cache copy per layer (custom calls need dense operands)
+        o = flash_decode_attention(
+            qp, kv_buf, pos, n_kv_heads=cfg.kv_heads, layer=i,
+            kv_scales=sc_buf,
+        )
+        o_flat = (
+            o.reshape(b, grp, cfg.kv_heads, kd)
+            .transpose(0, 2, 1, 3)
+            .reshape(b, cfg.n_heads * kd)
+        )
         x = x + o_flat @ _w(p, "wo", x.dtype).reshape(
             cfg.n_heads * kd, -1
         )
@@ -1040,17 +1024,7 @@ def transformer_generate(cfg: TransformerConfig):
         caches, logits = do_prefill(params, init_caches(b, total), prompt)
 
         def sample(logits, key):
-            if top_k is not None:
-                # approx_top_k swaps the exact sort for the TPU-native
-                # approx_max_k (PartialReduce): the exact top-40 over
-                # V=50304 measured 758us/step — 29% of decode device
-                # time — vs ~recall-0.95 for the approximate threshold.
-                # The standard serving trade; default stays exact.
-                if approx_top_k:
-                    kth = lax.approx_max_k(logits, top_k)[0][..., -1:]
-                else:
-                    kth = lax.top_k(logits, top_k)[0][..., -1:]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            logits = _top_k_filter(logits, top_k, approx_top_k)
             if temperature == 0:
                 return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
             return jax.random.categorical(
@@ -1147,6 +1121,392 @@ def transformer_beam_search(cfg: TransformerConfig):
         return full, scores
 
     return beam
+
+
+def _top_k_filter(logits, top_k: int | None, approx_top_k: bool):
+    """Top-k threshold filter on logits — ONE implementation shared by
+    ``transformer_generate``'s sampler and speculative decoding's
+    draft/verify distributions, so the filter semantics (exact sort vs
+    the TPU-native ``approx_max_k`` threshold — the exact top-40 over
+    V=50304 measured 758us/step, 29% of decode device time, vs
+    recall~0.95 for the approximate; kth-logit tie handling) cannot
+    drift between the paths the bench compares row-to-row."""
+    if top_k is None:
+        return logits
+    if approx_top_k:
+        kth = lax.approx_max_k(logits, top_k)[0][..., -1:]
+    else:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _filtered_probs(logits, temperature: float, top_k: int | None,
+                    approx_top_k: bool = False):
+    """The sampling distribution as explicit probabilities (f32):
+    top-k filter then temperature softmax; ``temperature=0`` is a
+    one-hot argmax. Shared by speculative decoding's draft and verify
+    sides so the acceptance ratio compares the same family of filtered
+    distributions the plain sampler uses (the filter DEFINES the target
+    distribution, so exactness is w.r.t. the filtered target)."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0:
+        return jax.nn.one_hot(
+            jnp.argmax(logits, -1), logits.shape[-1], dtype=jnp.float32
+        )
+    logits = _top_k_filter(logits, top_k, approx_top_k)
+    return jax.nn.softmax(logits / temperature, axis=-1)
+
+
+def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0):
+    """One transformer block over C consecutive cached-decode positions
+    (x: (B, C, D), rows pos0..pos0+C-1): projection, RoPE, cache write,
+    dense masked attention against the cache, MLP/MoE tail. ONE
+    implementation serving both ``block_decode``'s non-kernel path
+    (C=1) and the speculative verify chunk — the dense decode numerics
+    cannot drift from the verify numerics because they are the same
+    code."""
+    b, c, _ = x.shape
+    kd = cfg.head_dim
+    grp = cfg.n_heads // cfg.kv_heads
+    h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    q, k_r, v_r = _project_qkv(cfg, p, h_in)  # (B,H,C,K), (B,Hkv,C,K)
+    if cfg.rope:
+        cos, sin = _rope_tables(
+            pos0 + jnp.arange(c), cfg.head_dim, x.dtype
+        )  # (C, hd/2)
+        q = _apply_rope(q, cos[None, None], sin[None, None])
+        k_r = _apply_rope(k_r, cos[None, None], sin[None, None])
+    kv_rows = jnp.stack(
+        [
+            k_r.transpose(0, 2, 1, 3).reshape(b, c, -1),
+            v_r.transpose(0, 2, 1, 3).reshape(b, c, -1),
+        ]
+    )[None]  # (1, 2, B, C, Hkv*K)
+    if cfg.decode_int8:
+        kv_buf, sc_buf = kv_all["kv"], kv_all["scale"]
+        q_rows, s_rows = _quantize_int8(
+            kv_rows.astype(jnp.float32), (-1,)
+        )
+        kv_buf = lax.dynamic_update_slice(
+            kv_buf, q_rows, (i, 0, 0, pos0, 0)
+        )
+        sc_buf = lax.dynamic_update_slice(
+            sc_buf, s_rows, (i, 0, 0, pos0, 0)
+        )
+        kv_all = {"kv": kv_buf, "scale": sc_buf}
+        ck = (kv_buf[i, 0].astype(jnp.float32)
+              * sc_buf[i, 0]).astype(x.dtype)
+        cv = (kv_buf[i, 1].astype(jnp.float32)
+              * sc_buf[i, 1]).astype(x.dtype)
+    else:
+        kv_all = lax.dynamic_update_slice(
+            kv_all, kv_rows.astype(kv_all.dtype), (i, 0, 0, pos0, 0)
+        )
+        ck, cv = kv_all[i, 0], kv_all[i, 1]
+    tpad = ck.shape[1]
+    ck4 = ck.reshape(b, tpad, cfg.kv_heads, kd)
+    cv4 = cv.reshape(b, tpad, cfg.kv_heads, kd)
+    qg = q.reshape(b, cfg.kv_heads, grp, c, kd)  # head = kv*G + g
+    att = jnp.einsum(
+        "bhgck,bthk->bhgct", qg, ck4
+    ) / jnp.sqrt(kd).astype(x.dtype)
+    mask = (
+        jnp.arange(tpad)[None, :]
+        <= (pos0 + jnp.arange(c))[:, None]
+    )  # (C, Tpad) causal against the cache
+    att = jnp.where(mask[None, None, None], att, -jnp.inf)
+    w_att = jax.nn.softmax(att, axis=-1)
+    o = jnp.einsum("bhgct,bthk->bhgck", w_att, cv4)
+    o_flat = o.transpose(0, 3, 1, 2, 4).reshape(
+        b, c, cfg.n_heads * kd
+    )
+    x = x + jnp.einsum(
+        "bch,hd->bcd", o_flat,
+        _w(p, "wo", x.dtype).reshape(cfg.n_heads * kd, -1),
+    )
+    h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    if cfg.n_experts:
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_reference,
+        )
+
+        moe_params = jax.tree.map(
+            lambda a: a.astype(x.dtype), p["moe"]
+        )
+        flat = h_in.reshape(-1, h_in.shape[-1])
+        y = moe_reference(
+            moe_params, flat, k=cfg.moe_k, activation=jax.nn.gelu
+        )
+        x = x + y.reshape(h_in.shape)
+    else:
+        x = x + _mlp(p, h_in)
+    return x, kv_all
+
+def _chunk_builder(cfg: TransformerConfig):
+    """Chunked cached forward — the verify side of speculative decoding:
+    ``forward_chunk(params, caches, toks (B, C), pos0)`` advances C
+    consecutive positions (pos0..pos0+C-1) through all layers against
+    the live KV cache in ONE pass and returns (logits (B, C, V),
+    caches). Decode is weight-stream-bound, so verifying C=k+1 draft
+    positions costs ~one decode step of HBM traffic, not k: the C
+    queries ride the same streamed weights as a single wide MXU dot.
+    Per-layer work delegates to :func:`_block_chunk` — the same code
+    ``block_decode``'s non-kernel path runs at C=1."""
+
+    def forward_chunk(params, caches, toks, pos0):
+        b, c = toks.shape
+        # per-index clip: positions past max_len (possible only for
+        # slots whose outputs are discarded at the buffer slice) clamp
+        # individually instead of shifting the whole slice
+        pos_rows = jnp.take(
+            params["pos"], pos0 + jnp.arange(c), axis=0, mode="clip"
+        )
+        x = (params["embed"][toks] + pos_rows[None]).astype(
+            cfg.compute_dtype
+        )
+        kv_all = caches
+        for i in range(cfg.n_layers):
+            p_i = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+            x, kv_all = _block_chunk(cfg, x, p_i, kv_all, i, pos0)
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+        logits = jnp.einsum(
+            "bcd,dv->bcv", x, _w(params, "head", x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits, kv_all
+
+    return forward_chunk
+
+
+def transformer_speculative_generate(
+    cfg: TransformerConfig, draft_cfg: TransformerConfig | None = None
+):
+    """Speculative decoding: a cheap draft model proposes ``draft_k``
+    tokens autoregressively, the target model verifies all of them in
+    one chunked forward, and rejection sampling keeps the output an
+    exact sample from the target's (filtered) distribution
+    [Leviathan et al. 2023; Chen et al. 2023 — the published
+    algorithm, implemented here from the math].
+
+    Exactness caveat (true of ANY floating-point implementation of the
+    algorithm): "the target's distribution" means the target weights as
+    computed by the chunked verify program. That program is a
+    differently-scheduled XLA lowering than ``transformer_generate``'s
+    serial decode, so their logits agree only to float-reassociation
+    level (~1e-2 relative on random-init models) — at temperature 0
+    the two decoders emit identical tokens except where the top-2
+    logit margin is inside that band (near-ties). The acceptance MATH
+    is exact for whatever p the verify program produces; the
+    guarantee is distribution-level w.r.t. that program, not bitwise
+    token equality with the serial decoder.
+
+    TPU-first shape: the natural draft on one chip is the SAME model
+    weight-only int8 quantized (``quantize_decode_params`` with
+    ``draft_cfg`` = the int8 variant) — near-1 acceptance because
+    draft≈target, ~half the weight stream per draft step, and
+    target-distribution outputs, turning the lossy quantization
+    speedup into a distribution-preserving one at B=1 (the latency
+    row PERF.md's wall analysis says no byte savings can otherwise
+    reach).
+
+    Returns ``generate(params, draft_params, prompt, key, max_new,
+    draft_k, temperature, top_k, approx_top_k, return_stats) ->
+    tokens (1, Tp + max_new)`` (with ``return_stats`` also a
+    ``{"rounds": n}`` dict — rounds ≈ max_new/(k+1) at perfect
+    acceptance, the efficiency diagnostic). Batch is fixed at 1:
+    acceptance lengths are ragged across batch rows, and the feature
+    targets interactive latency (the B>=16 throughput rows are already
+    weight-amortized). Prompts need >= 2 tokens (each round's first
+    draft step is a 2-token catch-up chunk). The whole loop is one
+    jittable ``lax.while_loop``; both caches stay device-resident.
+
+    ≙ the serving capability the reference's era lacked entirely; the
+    sampling surface matches ``transformer_generate``
+    (LSTM.java:219 ≙ sampleDoc at the transformer level).
+    """
+    if draft_cfg is None:
+        draft_cfg = cfg
+    _, t_init, t_prefill, t_cast = _decode_builder(cfg)
+    t_chunk = _chunk_builder(cfg)
+    d_fwd1, d_init, d_prefill, d_cast = _decode_builder(draft_cfg)
+    d_chunk = _chunk_builder(draft_cfg)
+
+    def generate(params, draft_params, prompt, key, max_new: int,
+                 draft_k: int = 4, temperature: float = 1.0,
+                 top_k: int | None = None, approx_top_k: bool = False,
+                 return_stats: bool = False):
+        b, tp = prompt.shape
+        if b != 1:
+            raise ValueError(
+                "speculative decode is the B=1 latency path (acceptance "
+                "lengths are ragged across batch rows)"
+            )
+        if tp < 2:
+            raise ValueError(
+                "speculative decode needs a prompt of >= 2 tokens (each "
+                "round's first draft step is a 2-token catch-up chunk)"
+            )
+        k = int(draft_k)
+        assert k >= 1
+        total = _check_decode_len(cfg, tp, max_new)
+        _check_decode_len(draft_cfg, tp, max_new)
+        v = cfg.vocab_size
+        params = t_cast(params)
+        draft_params = d_cast(draft_params)
+        # caches padded by k+1 rows: a round may write (and later
+        # overwrite) up to k+1 positions past the accepted prefix
+        caches_t = t_init(b, total + k + 1)
+        caches_d = d_init(b, total + k + 1)
+        # lag-one prefill: the last prompt token is NOT consumed — each
+        # round's chunk/draft feeds it first, so the target cache always
+        # trails the emitted prefix by exactly one row. The lag would
+        # push a flash-aligned prompt (%128 above one block —
+        # _flash_seq_ok) off the kernel path, so bulk-prefill the
+        # aligned PREFIX and chunk-forward the <=127-token remainder.
+        pre = tp - 1  # >= 1: the tp >= 2 guard above
+        aligned = pre - (pre % 128) if pre > 128 else pre
+        if aligned:
+            caches_t, _ = t_prefill(
+                params, caches_t, prompt[:, :aligned]
+            )
+            caches_d, _ = d_prefill(
+                draft_params, caches_d, prompt[:, :aligned]
+            )
+        if pre - aligned:
+            rest = prompt[:, aligned:pre]
+            _, caches_t = t_chunk(params, caches_t, rest, aligned)
+            _, caches_d = d_chunk(draft_params, caches_d, rest, aligned)
+        c_prev2 = prompt[:, -2].astype(jnp.int32)
+        c_prev = prompt[:, -1].astype(jnp.int32)
+        buf = jnp.zeros((b, total + k + 1), jnp.int32)
+        buf = lax.dynamic_update_slice(
+            buf, prompt.astype(jnp.int32), (0, 0)
+        )
+
+        def pick(p, kk):
+            if temperature == 0:
+                return jnp.argmax(p, -1).astype(jnp.int32)
+            return jax.random.categorical(
+                kk, jnp.log(p + 1e-30), axis=-1
+            ).astype(jnp.int32)
+
+        def cond(carry):
+            return carry[3] < total
+
+        def body(carry):
+            caches_t, caches_d, buf, pos, c_prev2, c_prev, key, rounds = carry
+            key, kd1, kdr, ku, kc = jax.random.split(key, 5)
+
+            # first draft step is a 2-token catch-up chunk over the two
+            # tokens behind the cursor: after a fully-accepted round the
+            # draft cache is missing d_k's row (the draft sampled d_k
+            # but never fed it) AND the correction token's row — this
+            # chunk writes both (rewrites are idempotent: a row is a
+            # deterministic function of its token, position, and the
+            # rows before it), so no permanent zero row can enter the
+            # attention window and erode acceptance
+            pair = jnp.concatenate(
+                [c_prev2[:, None], c_prev[:, None]], axis=1
+            )
+            lg2, caches_d = d_chunk(draft_params, caches_d, pair, pos - 2)
+            q1 = _filtered_probs(
+                lg2[:, 1], temperature, top_k, approx_top_k
+            )  # (B, V)
+            d1 = pick(q1, kd1)
+
+            # remaining k-1 draft tokens serially (each step ~the
+            # quantized weight stream), recording proposal distributions
+            def dstep(dc, i):
+                caches_d, tok, kk = dc
+                kk, ks = jax.random.split(kk)
+                lg, caches_d = d_fwd1(
+                    draft_params, caches_d, tok, pos - 1 + i
+                )
+                qv = _filtered_probs(
+                    lg, temperature, top_k, approx_top_k
+                )  # (B, V)
+                d = pick(qv, ks)
+                return (caches_d, d, kk), (d, qv)
+
+            (caches_d, _, _), (ds_rest, qs_rest) = lax.scan(
+                dstep, (caches_d, d1, kdr), jnp.arange(1, k)
+            )
+            ds_t = jnp.concatenate(
+                [d1[:, None], ds_rest.T], axis=1
+            )  # (B, k)
+            qs_t = jnp.concatenate(
+                [q1[:, None], jnp.transpose(qs_rest, (1, 0, 2))], axis=1
+            )  # (B, k, V)
+
+            # verify: ONE chunked target forward over
+            # [c_prev, d_1..d_k] yields p for every draft slot + bonus
+            chunk_toks = jnp.concatenate(
+                [c_prev[:, None], ds_t], axis=1
+            )  # (B, k+1)
+            vlg, caches_t = t_chunk(params, caches_t, chunk_toks, pos - 1)
+            ps = _filtered_probs(
+                vlg, temperature, top_k, approx_top_k
+            )  # (B, k+1, V)
+
+            # rejection sampling: accept d_i with prob min(1, p/q);
+            # u*q < p is the division-free form
+            p_d = jnp.take_along_axis(
+                ps[:, :k], ds_t[..., None], -1
+            )[..., 0]  # (B, k)
+            q_d = jnp.take_along_axis(qs_t, ds_t[..., None], -1)[..., 0]
+            u = jax.random.uniform(ku, (b, k))
+            accept = u * jnp.maximum(q_d, 1e-30) < p_d
+            n = jnp.sum(
+                jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+            )  # (B,) accepted count; k = all accepted
+
+            # correction token: on reject at slot n sample the residual
+            # max(p-q, 0)/Z; with n=k the padded q row is zero so the
+            # SAME formula samples the bonus token from p directly
+            qs_pad = jnp.concatenate(
+                [qs_t, jnp.zeros((b, 1, v), qs_t.dtype)], axis=1
+            )
+            pn = jnp.take_along_axis(ps, n[:, None, None], axis=1)[:, 0]
+            qn = jnp.take_along_axis(
+                qs_pad, n[:, None, None], axis=1
+            )[:, 0]
+            resid = jnp.maximum(pn - qn, 0.0)
+            rs = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(rs > 0, resid / rs, pn)
+            ctok = pick(resid, kc)  # (B,)
+
+            # emit d_1..d_n then the correction token at slot n; slots
+            # past n are scratch (overwritten by later rounds, sliced
+            # off at the end)
+            jj = jnp.arange(k + 1)[None, :]
+            ds_pad = jnp.concatenate(
+                [ds_t, jnp.zeros((b, 1), ds_t.dtype)], axis=1
+            )
+            tile = jnp.where(
+                jj < n[:, None], ds_pad,
+                jnp.where(jj == n[:, None], ctok[:, None], 0),
+            ).astype(jnp.int32)
+            buf = lax.dynamic_update_slice(buf, tile, (0, pos))
+            # the new cursor is pos+n+1; the token two behind it is d_n
+            # (n>=1) or the incoming c_prev (n==0)
+            prev2_new = jnp.where(
+                n == 0, c_prev,
+                jnp.take_along_axis(
+                    ds_t, jnp.maximum(n - 1, 0)[:, None], axis=1
+                )[:, 0],
+            )
+            return (caches_t, caches_d, buf, pos + n[0] + 1,
+                    prev2_new, ctok, key, rounds + 1)
+
+        init = (caches_t, caches_d, buf, jnp.int32(tp), c_prev2, c_prev,
+                key, jnp.int32(0))
+        fin = lax.while_loop(cond, body, init)
+        out = fin[2][:, :total]
+        if return_stats:
+            return out, {"rounds": fin[7]}
+        return out
+
+    return generate
 
 
 def fsdp_shardings(mesh: Mesh, cfg: TransformerConfig):

@@ -369,6 +369,233 @@ def test_int8_weights_decode_under_dp_tp_mesh():
 
 
 @pytest.mark.slow
+def test_chunk_forward_matches_sequential_decode():
+    """The speculative-verify chunk forward must equal C sequential
+    single-token decode steps — same cache layout, same logits — on
+    both the GQA+RoPE geometry and the fully-int8 cache mode."""
+    import dataclasses
+
+    from deeplearning4j_tpu.models.transformer import (
+        _chunk_builder,
+        _decode_builder,
+        quantize_decode_params,
+    )
+
+    C = 5
+    base = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=32, n_kv_heads=2, rope=True,
+    )
+    for cfg, params in [
+        (base, init_transformer(jax.random.key(0), base)),
+        (
+            # decode_kernel=False: the sequential side must use the
+            # dense fallback — the int8 KERNEL quantizes q and the
+            # softmax weights in-register (an extra ~1% error source
+            # the dense chunk deliberately lacks), so kernel-vs-chunk
+            # only agrees at the token level, not logits-atol level
+            dataclasses.replace(
+                base, decode_int8=True, decode_kernel=False
+            ),
+            quantize_decode_params(
+                init_transformer(jax.random.key(0), base), base
+            ),
+        ),
+    ]:
+        f1, ic, _pf, cp = _decode_builder(cfg)
+        chunk = _chunk_builder(cfg)
+        toks = _tokens(2, C, seed=3)
+        p = cp(params)
+        seq_caches = ic(2, 16)
+        seq_logits = []
+        for i in range(C):
+            lg, seq_caches = f1(p, seq_caches, toks[:, i], i)
+            seq_logits.append(lg)
+        ch_logits, ch_caches = chunk(p, ic(2, 16), toks, 0)
+        for i in range(C):
+            np.testing.assert_allclose(
+                np.asarray(ch_logits[:, i]), np.asarray(seq_logits[i]),
+                atol=2e-3, err_msg=f"slot {i} int8={cfg.decode_int8}",
+            )
+        # ...and against bulk prefill: block_chunk is a third copy of
+        # the transformer block (prefill's layer / block_decode's dense
+        # fallback are the others) — this pins chunk-vs-prefill so the
+        # copies cannot drift (cache rows written must be identical)
+        pf_caches, _ = _pf(cp(params), ic(2, 16), toks)
+
+        def rows(c):
+            # dequantize int8 caches: float-association differences
+            # between the two paths may flip one quantization LSB, so
+            # raw int8 planes are compared at value level
+            if isinstance(c, dict):
+                return (
+                    np.asarray(c["kv"][:, :, :, :C], np.float32)
+                    * np.asarray(c["scale"][:, :, :, :C], np.float32)
+                )
+            return np.asarray(c[:, :, :, :C], np.float32)
+
+        np.testing.assert_allclose(
+            rows(ch_caches), rows(pf_caches),
+            # int8: float-association differences between the paths can
+            # shift a row's amax (hence its scale) — allow ~2 quant LSBs
+            atol=6e-2 if cfg.decode_int8 else 2e-2,
+            err_msg=f"cache rows int8={cfg.decode_int8}",
+        )
+
+
+@pytest.mark.slow
+def test_speculative_greedy_matches_plain_up_to_near_ties():
+    """The greedy contract for ANY draft: the speculative chain must
+    follow the plain greedy decode except where the plain decoder's
+    top-2 logit margin is inside the cross-program float-reassociation
+    band (the verify chunk is a differently-scheduled XLA program than
+    the serial decoder — see the transformer_speculative_generate
+    docstring). So: walk the plain chain teacher-forced; at the first
+    speculative divergence the plain logits' top-2 margin must be
+    small (a near-tie), and agreement before it must be total.
+    Checked for an adversarial unrelated draft (worst case: near-zero
+    acceptance) and the int8w-quantized self (production case)."""
+    import functools
+
+    from deeplearning4j_tpu.models.transformer import (
+        quantize_decode_params,
+        transformer_apply,
+        transformer_generate,
+        transformer_speculative_generate,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=96, n_kv_heads=2, rope=True,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    prompt = _tokens(1, 8, seed=11)
+    new = 20
+    ref = np.asarray(
+        jax.jit(functools.partial(
+            transformer_generate(cfg), max_new=new, temperature=0.0
+        ))(params, prompt, jax.random.key(1))
+    )
+    apply = jax.jit(transformer_apply(cfg))
+
+    def check(out):
+        out = np.asarray(out)
+        np.testing.assert_array_equal(out[:, :8], np.asarray(prompt))
+        diff = np.nonzero(out[0, 8:] != ref[0, 8:])[0]
+        if diff.size == 0:
+            return  # bitwise-identical chain
+        first = int(diff[0])
+        # the full-forward logits at the divergence point: the two
+        # candidate tokens must be a near-tie there
+        ctx = jnp.asarray(ref[:, : 8 + first])
+        logits, _ = apply(params, ctx)
+        top2 = np.sort(np.asarray(logits[0, -1], np.float32))[-2:]
+        margin = float(top2[1] - top2[0])
+        assert margin < 0.05, (
+            f"speculative chain left the greedy chain at +{first} with "
+            f"a clear margin {margin:.3f} — not a near-tie flip"
+        )
+
+    sg = jax.jit(functools.partial(
+        transformer_speculative_generate(cfg), max_new=new, draft_k=3,
+        temperature=0.0,
+    ))
+    # adversarial draft: a different random init
+    bad_draft = init_transformer(jax.random.key(99), cfg)
+    check(sg(params, bad_draft, prompt, jax.random.key(2)))
+    # production draft: the int8w-quantized self
+    qdraft = quantize_decode_params(params, cfg)
+    check(sg(params, qdraft, prompt, jax.random.key(3)))
+
+
+@pytest.mark.slow
+def test_speculative_sampled_determinism_and_guards():
+    import functools
+
+    from deeplearning4j_tpu.models.transformer import (
+        quantize_decode_params,
+        transformer_speculative_generate,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=96,
+    )
+    params = init_transformer(jax.random.key(7), cfg)
+    qdraft = quantize_decode_params(params, cfg)
+    sg = jax.jit(functools.partial(
+        transformer_speculative_generate(cfg), max_new=24, draft_k=4,
+        temperature=1.0, top_k=8,
+    ))
+    prompt = _tokens(1, 6, seed=7)
+    a = np.asarray(sg(params, qdraft, prompt, jax.random.key(1)))
+    b = np.asarray(sg(params, qdraft, prompt, jax.random.key(1)))
+    c = np.asarray(sg(params, qdraft, prompt, jax.random.key(2)))
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any()
+    assert a.shape == (1, 30)
+    assert ((a >= 0) & (a < cfg.vocab_size)).all()
+    # the prompt passes through untouched
+    np.testing.assert_array_equal(a[:, :6], np.asarray(prompt))
+    # ragged-batch guard
+    with pytest.raises(ValueError, match="B=1"):
+        transformer_speculative_generate(cfg)(
+            params, qdraft, _tokens(2, 6, seed=7), jax.random.key(0), 4
+        )
+
+
+@pytest.mark.slow
+def test_speculative_acceptance_efficiency_with_identical_draft():
+    """With draft == target (same params, dense fallback both sides),
+    greedy acceptance must be perfect: max_new tokens in
+    ceil(max_new/(k+1)) rounds. This pins the draft-cache catch-up
+    chunk — before it, every fully-accepted round left a permanent
+    zero KV row (the sampled-but-never-fed d_k) in the draft cache,
+    silently eroding acceptance while outputs stayed exact."""
+    import dataclasses
+    import functools
+
+    from deeplearning4j_tpu.models.transformer import (
+        transformer_speculative_generate,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_len=96, n_kv_heads=2, rope=True,
+        decode_kernel=False,  # draft numerics == verify-chunk numerics
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    k, new = 4, 30
+    sg = jax.jit(functools.partial(
+        transformer_speculative_generate(cfg), max_new=new, draft_k=k,
+        temperature=0.0, return_stats=True,
+    ))
+    out, stats = sg(params, params, _tokens(1, 8, seed=5), jax.random.key(1))
+    assert out.shape == (1, 38)
+    # perfect acceptance: 30 tokens, 5 per round (k accepted + bonus)
+    assert int(stats["rounds"]) == -(-new // (k + 1)), int(stats["rounds"])
+
+
+def test_speculative_acceptance_math_matches_target_distribution():
+    """The rejection-sampling identity the in-graph round implements:
+    draft d~q, accept iff u*q[d] < p[d], else emit from max(p-q,0)/Z —
+    the emitted marginal must equal p exactly (Leviathan et al. thm 1).
+    Validated by Monte Carlo with the same division-free formulas."""
+    rng = np.random.default_rng(0)
+    v, n = 6, 200_000
+    p = rng.dirichlet(np.ones(v))
+    q = rng.dirichlet(np.ones(v))
+    d = rng.choice(v, size=n, p=q)
+    u = rng.uniform(size=n)
+    accept = u * q[d] < p[d]
+    resid = np.maximum(p - q, 0)
+    resid = resid / resid.sum()
+    out = np.where(accept, d, rng.choice(v, size=n, p=resid))
+    emp = np.bincount(out, minlength=v) / n
+    assert np.abs(emp - p).sum() < 0.02, (emp, p)
+
+
+@pytest.mark.slow
 def test_sampled_generate_is_deterministic_per_key_and_respects_top_k():
     from deeplearning4j_tpu.models.transformer import transformer_generate
 
