@@ -39,6 +39,14 @@ per workload — the driver's round record captures all of them:
                   latency point (batch 1): the step is almost purely the
                   weight stream, so this row isolates what quantization
                   buys a single-user session
+- ``transformer-decode-gqa-8kctx`` / ``-8kctx-int8`` long-context
+                  serving (prefill 8192 + 256 decode steps, B=16).
+                  Measured, the int8-cache row REFUTES the r5
+                  prediction that quantization pays most here: the
+                  bf16 kernel already sustains ~61% of HBM peak at
+                  8k, and the int8 kernel's per-cell quantize/rescale
+                  work outruns its byte savings — net 14% loss
+                  (PERF.md "8k-context serving")
 - ``transformer-decode-gqa-b1-spec`` speculative decoding at B=1:
                   the int8w-quantized self drafts k tokens, the bf16
                   target verifies them in one chunked forward, rejection
@@ -516,7 +524,9 @@ def _verify_int8_decode(weights_only: bool = False,
 _DECODE_PROMPT_LEN, _DECODE_NEW = 512, 64
 
 
-def _decode_bench_cfg(args, batch: int, gqa: bool, int8: str = "off"):
+def _decode_bench_cfg(args, batch: int, gqa: bool, int8: str = "off",
+                      prompt_len: int = _DECODE_PROMPT_LEN,
+                      new: int = _DECODE_NEW):
     """ONE construction of the serving-bench model config + prompt,
     shared by the plain/int8 decode rows and the speculative row — so
     the spec row measures exactly the geometry of the rows it is
@@ -532,10 +542,11 @@ def _decode_bench_cfg(args, batch: int, gqa: bool, int8: str = "off"):
     cfg = TransformerConfig(
         vocab_size=p["vocab"], d_model=p["d_model"], n_heads=p["n_heads"],
         n_layers=p["n_layers"], d_ff=p["d_ff"],
-        max_len=_DECODE_PROMPT_LEN + _DECODE_NEW + 1,
-        # flash is honored by the bulk-prefill path (the 512-token
-        # prompt satisfies the kernel's alignment); the per-token
-        # decode steps use the KV-cache path either way
+        max_len=prompt_len + new + 1,
+        # flash is honored by the bulk-prefill path (every preset's
+        # prompt_len — 512 default, 8192 longctx — satisfies the
+        # kernel's %128 alignment); the per-token decode steps use the
+        # KV-cache path either way
         use_flash=flash,
         compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
         decode_int8=(int8 == "full"),
@@ -544,17 +555,19 @@ def _decode_bench_cfg(args, batch: int, gqa: bool, int8: str = "off"):
     )
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
-        rng.integers(0, p["vocab"], (batch, _DECODE_PROMPT_LEN)).astype(
-            np.int32
-        )
+        rng.integers(0, p["vocab"], (batch, prompt_len)).astype(np.int32)
     )
     return cfg, prompt, p
 
 
 def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
-                  int8: str = "off", gqa: bool = False):
+                  int8: str = "off", gqa: bool = False,
+                  prompt_len: int = _DECODE_PROMPT_LEN,
+                  new: int = _DECODE_NEW):
     """KV-cached autoregressive decode throughput on the GPT-2-small
-    config: bulk prefill (512 tokens) + 64 sampled steps per call, all
+    config: bulk prefill (``prompt_len``, default 512; 8192 for the
+    8kctx rows) + ``new`` sampled steps (default 64; 256 for 8kctx —
+    enough that the cache stream dominates the window) per call, all
     inside one jitted program. Reported rate counts only the NEW tokens
     (prefill attributed as overhead — the conservative convention), so
     the number is directly the serving-side tokens/sec/chip.
@@ -585,8 +598,9 @@ def _bench_decode(args, batch: int = 16, metric_suffix: str = "",
         transformer_generate,
     )
 
-    prompt_len, new = _DECODE_PROMPT_LEN, _DECODE_NEW
-    cfg, prompt, p = _decode_bench_cfg(args, batch, gqa, int8)
+    cfg, prompt, p = _decode_bench_cfg(
+        args, batch, gqa, int8, prompt_len=prompt_len, new=new
+    )
     params = init_transformer(jax.random.key(0), cfg)
     if int8 != "off":
         _verify_int8_decode(weights_only=(int8 == "weights"), gqa=gqa)
@@ -800,6 +814,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-int8w", "transformer-decode-gqa-b64-int8w",
     "transformer-decode-gqa-b1", "transformer-decode-gqa-b1-int8w",
     "transformer-decode-gqa-b1-spec",
+    "transformer-decode-gqa-8kctx", "transformer-decode-gqa-8kctx-int8",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -819,6 +834,8 @@ _AUTO_DTYPE = {
     "transformer-decode-gqa-b1": "bf16",
     "transformer-decode-gqa-b1-int8w": "bf16",
     "transformer-decode-gqa-b1-spec": "bf16",
+    "transformer-decode-gqa-8kctx": "bf16",
+    "transformer-decode-gqa-8kctx-int8": "bf16",
 }
 
 
@@ -939,24 +956,31 @@ def _run_one_inner(args, jax) -> None:
         )
         b64 = "-b64" in args.model
         b1 = "-b1" in args.model
+        longctx = "-8kctx" in args.model
         gqa = "-gqa" in args.model
         batch = 64 if b64 else 1 if b1 else 16
+        # the long-context serving point: prefill 8192, then enough
+        # decode steps (256) that the cache stream — the thing int8
+        # halves — dominates the window rather than the prefill
+        prompt_len = 8192 if longctx else _DECODE_PROMPT_LEN
+        new = 256 if longctx else _DECODE_NEW
         suffix = (
             ("_gqa" if gqa else "")
             + ("_b64" if b64 else "_b1" if b1 else "")
+            + ("_8kctx" if longctx else "")
             + {"off": "", "full": "_int8", "weights": "_int8w"}[int8]
         )
 
         def run_decode():
             v, _m, u = _bench_decode(
                 args, batch=batch, metric_suffix=suffix,
-                int8=int8, gqa=gqa,
+                int8=int8, gqa=gqa, prompt_len=prompt_len, new=new,
             )
             return v, u
 
         per_chip, metric, mbu = _bench_decode(
             args, batch=batch, metric_suffix=suffix,
-            int8=int8, gqa=gqa,
+            int8=int8, gqa=gqa, prompt_len=prompt_len, new=new,
         )
         _report(args, per_chip, metric, jax, util=mbu, util_key="mbu",
                 remeasure=run_decode)
