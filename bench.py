@@ -41,12 +41,14 @@ per workload — the driver's round record captures all of them:
                   buys a single-user session
 - ``transformer-decode-gqa-8kctx`` / ``-8kctx-int8`` long-context
                   serving (prefill 8192 + 256 decode steps, B=16).
-                  Measured, the int8-cache row REFUTES the r5
-                  prediction that quantization pays most here: the
-                  bf16 kernel already sustains ~61% of HBM peak at
-                  8k, and the int8 kernel's per-cell quantize/rescale
-                  work outruns its byte savings — net 14% loss
-                  (PERF.md "8k-context serving")
+                  Adding the row surfaced (and fixed, +24.6%) the
+                  decode kernel's short-T-tuned block cap; with the
+                  VMEM-driven policy the int8-cache row still REFUTES
+                  the r5 prediction that quantization pays most here:
+                  bf16 sustains MBU 0.54 at 8k and the int8 kernel's
+                  per-cell quantize/rescale work outruns its byte
+                  savings — net 20% loss (PERF.md "8k-context
+                  serving")
 - ``transformer-decode-gqa-b1-spec`` speculative decoding at B=1:
                   the int8w-quantized self drafts k tokens, the bf16
                   target verifies them in one chunked forward, rejection

@@ -678,15 +678,32 @@ def flash_decode_attention(
     # the block search below requires an 8-aligned T to terminate
     assert t % 8 == 0, f"cache T dim must be a multiple of 8, got {t}"
     if block_t is None:
-        # one block up to T=1024 (fewer grid cells measurably beats
-        # smaller streamed blocks here — per-cell overhead dominates at
-        # this arithmetic intensity), splitting only when VMEM demands:
-        # smallest divisor count that keeps blocks <= 1024 and 8-aligned.
-        # Callers size T as a multiple of 512 above 1024 (init_caches),
-        # which guarantees this search lands on blocks in [512, 1024];
-        # an adversarial T (8*prime) would otherwise walk down to 8-row
-        # blocks and pay ~100x the per-cell fixed cost.
-        n_t = -(-t // 1024)
+        # as FEW t blocks as VMEM allows: per-cell fixed costs dominate
+        # at this arithmetic intensity, so bigger blocks win as long as
+        # they fit — at T=8704 raising the block from 512 to 4352
+        # measured +24.5% tok/s (r5 "8k-context serving"). The ceiling
+        # is the ~16MB scoped VMEM budget: the K and V block planes,
+        # double-buffered by the pipeline, are the dominant allocation
+        # (a single 8704-row bf16 block OOMed at 17.04M, matching the
+        # 4-plane estimate), so cap rows at ~12MB / (hk * itemsize * 4)
+        # with headroom for q/out/scratch. int8 caches stream half the
+        # HBM bytes but the kernel's in-register conversion keeps extra
+        # per-block scratch: the measured single-block int8 OOM
+        # (25.54M at T=8704, hk=256) works out to ~2.87 bytes per
+        # element-plane, so int8 budgets at 3 — NOT its 1-byte stream
+        # size. The 14MB budget is sized so the measured-best bf16
+        # block (4352 at hk=256: 8.5M actual) and its int8 twin
+        # (12.8M actual) both land under the 16MB scoped limit with
+        # headroom. No floor overriding the budget: huge-hk geometries
+        # get correspondingly small blocks instead of an OOM. Then the
+        # smallest divisor count that keeps blocks under the cap and
+        # 8-aligned; callers size T as a multiple of 512 above 1024
+        # (init_caches), so the search lands on large blocks instead
+        # of walking down to 8-row blocks (an adversarial 8*prime T
+        # would pay ~100x per-cell).
+        eff_bytes = 3 if kvcache.dtype.itemsize == 1 else kvcache.dtype.itemsize
+        cap = max(8, (14 * 1024 * 1024) // (hk * eff_bytes * 4))
+        n_t = -(-t // cap)
         while t % n_t or (t // n_t) % 8:
             n_t += 1
         block_t = t // n_t
