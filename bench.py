@@ -731,6 +731,83 @@ def _bench_decode_spec(args):
     )
 
 
+def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
+                        mean_interarrival_s: float = 0.01):
+    """Continuous-batching serving under load: the GQA bf16 production
+    decode geometry behind the ``ServingEngine``, driven by a
+    DETERMINISTIC pseudo-Poisson arrival trace (seeded exponential
+    inter-arrivals, so every invocation replays the same offered load).
+    The arrival rate intentionally oversubscribes the slot batch —
+    requests queue, slots stay occupied, and the row reports what a
+    loaded endpoint shows: aggregate tok/s across all in-flight
+    requests plus p50/p99 time-to-first-token (queue wait INCLUDED —
+    TTFT is measured from submission, the user-visible number) and mean
+    slot occupancy (> 1 means iteration-level batching actually
+    interleaved requests; near ``n_slots`` means the engine kept the
+    batch full). Aggregate tok/s lands below the steady-state
+    ``transformer-decode-gqa`` rows by construction: the serving loop
+    pays per-step host scheduling + admission prefills inside the
+    window, which is exactly the overhead this row exists to price."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import init_transformer
+    from deeplearning4j_tpu.serving import (
+        Request,
+        RequestScheduler,
+        ServingEngine,
+        run_request_trace,
+    )
+
+    cfg, _, p = _decode_bench_cfg(args, batch=1, gqa=True)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    prompts = rng.integers(
+        0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
+    ).astype(np.int32)
+
+    def make_engine():
+        return ServingEngine(
+            cfg, params, n_slots=n_slots,
+            temperature=1.0, top_k=40,
+            approx_top_k=not args.exact_top_k,
+            scheduler=RequestScheduler(max_queue_depth=n_requests),
+        )
+
+    def make_trace():
+        return [
+            (float(arrivals[i]),
+             Request(prompt=prompts[i], max_new=_DECODE_NEW))
+            for i in range(n_requests)
+        ]
+
+    def replay():
+        engine = make_engine()
+        trace = make_trace()
+        t0 = time.perf_counter()
+        results = run_request_trace(engine, trace)
+        dt = time.perf_counter() - t0
+        assert len(results) == n_requests
+        s = engine.metrics.summary()
+        return s["n_generated"] / dt, s
+
+    replay()  # warmup: compiles the prefill + step programs
+    tok_per_sec, s = replay()
+    extra = {
+        "ttft_p50_s": round(s["ttft_p50_s"], 4),
+        "ttft_p99_s": round(s["ttft_p99_s"], 4),
+        "occupancy_mean": round(s["occupancy_mean"], 2),
+        "n_slots": n_slots,
+        "n_requests": n_requests,
+    }
+    return (
+        tok_per_sec,
+        "transformer_gpt2s_h128_decode_serve_tokens_per_sec_per_chip",
+        extra,
+    )
+
+
 def _bench_resnet(args):
     """ResNet-20 (He CIFAR recipe) training throughput — the modern CNN
     family the reference's era lacked (its conv story stops at
@@ -817,6 +894,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-b1", "transformer-decode-gqa-b1-int8w",
     "transformer-decode-gqa-b1-spec",
     "transformer-decode-gqa-8kctx", "transformer-decode-gqa-8kctx-int8",
+    "transformer-decode-serve",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -838,6 +916,7 @@ _AUTO_DTYPE = {
     "transformer-decode-gqa-b1-spec": "bf16",
     "transformer-decode-gqa-8kctx": "bf16",
     "transformer-decode-gqa-8kctx-int8": "bf16",
+    "transformer-decode-serve": "bf16",
 }
 
 
@@ -946,6 +1025,11 @@ def _run_one_inner(args, jax) -> None:
     if args.model.startswith("transformer-decode"):
         if args.scaling:
             raise SystemExit("--scaling does not apply to decode")
+        if args.model == "transformer-decode-serve":
+            per_chip, metric, extra = _bench_decode_serve(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (_bench_decode_serve(args)[0], None))
+            return
         if args.model.endswith("-spec"):
             per_chip, metric = _bench_decode_spec(args)
             _report(args, per_chip, metric, jax,
@@ -1107,11 +1191,13 @@ _REMEASURE_PAUSE_S = 8.0
 def _report(
     args, per_chip: float, metric: str, jax,
     util=None, util_key: str | None = None,
-    remeasure=None,
+    remeasure=None, extra: dict | None = None,
 ) -> None:
     """``util``/``util_key`` attach a utilization ratio under an explicit
     JSON key — "mfu" for FLOP-bound training workloads, "mbu" for the
-    bandwidth-bound decode workload. ``remeasure`` (no-arg callable
+    bandwidth-bound decode workload. ``extra`` merges additional keys
+    into the JSON record (the serving row's TTFT percentiles and slot
+    occupancy ride here). ``remeasure`` (no-arg callable
     returning a fresh ``(per_chip, util)`` measurement) enables the
     paired protocol: when the reading lands below ``_REMEASURE_BELOW``
     of baseline, the harness re-runs the same workload after a pause —
@@ -1178,6 +1264,8 @@ def _report(
     }
     if util_key is not None:
         out[util_key] = round(util, 4) if util is not None else None
+    if extra:
+        out.update(extra)
     if remeasured:
         out["remeasured"] = remeasured
     print(json.dumps(out))

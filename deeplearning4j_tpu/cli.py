@@ -305,24 +305,17 @@ def cmd_train(args) -> int:
     return 0
 
 
-def cmd_generate(args) -> int:
-    """Serve a trained transformer checkpoint: restore the params
-    (npz or orbax backend), optionally quantize for int8 serving, and
-    sample a continuation of --prompt (byte-level, matching train).
-
-    ≙ the reference's sampling entry points (LSTM.java:219 sampleDoc /
-    the char-RNN demo) as a standalone serving command; the int8 modes
-    are the PERF.md r5 production quantization."""
+def _restore_decode_model(args):
+    """Shared restore path for the decode-serving commands (generate /
+    serve): checkpoint params + config (npz or orbax backend), with the
+    --int8 off|weights|full quantization applied. Returns
+    ``(cfg, params)`` or an int exit code on failure."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from deeplearning4j_tpu.models.transformer import (
         TransformerConfig,
         init_transformer,
         quantize_decode_params,
-        transformer_beam_search,
-        transformer_generate,
     )
 
     import dataclasses
@@ -378,6 +371,30 @@ def cmd_generate(args) -> int:
         params = quantize_decode_params(params, cfg)
         print(f"int8 serving mode: {args.int8} "
               f"({'weights + kv cache' if args.int8 == 'full' else 'weights over a bf16/f32 cache'})")
+    return cfg, params
+
+
+def cmd_generate(args) -> int:
+    """Serve a trained transformer checkpoint: restore the params
+    (npz or orbax backend), optionally quantize for int8 serving, and
+    sample a continuation of --prompt (byte-level, matching train).
+
+    ≙ the reference's sampling entry points (LSTM.java:219 sampleDoc /
+    the char-RNN demo) as a standalone serving command; the int8 modes
+    are the PERF.md r5 production quantization."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import (
+        transformer_beam_search,
+        transformer_generate,
+    )
+
+    restored = _restore_decode_model(args)
+    if isinstance(restored, int):
+        return restored
+    cfg, params = restored
 
     prompt_bytes = args.prompt.encode("latin-1", errors="replace")
     room = cfg.max_len - len(prompt_bytes)
@@ -407,6 +424,57 @@ def cmd_generate(args) -> int:
         )
         text = bytes(np.asarray(out[0], np.uint8).tolist())
         print("sample:", text.decode("latin-1"))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Run the continuous-batching HTTP serving engine on a trained
+    checkpoint (or, with --demo, on a random-init model for smoke
+    testing the serving stack without a checkpoint).
+
+    POST /v1/generate {"prompt": "...", "max_new": N} against the
+    printed address; GET /metrics for TTFT/TPOT/occupancy summaries.
+    See the README "Serving" section for the engine architecture."""
+    import jax
+
+    from deeplearning4j_tpu.serving import (
+        RequestScheduler,
+        ServingEngine,
+        ServingServer,
+    )
+
+    if args.demo:
+        from deeplearning4j_tpu.models.transformer import init_transformer
+
+        cfg = _transformer_cfg_from_args(args)
+        params = init_transformer(jax.random.key(0), cfg)
+        print(f"demo mode: random-init model ({cfg.d_model}d, "
+              f"{cfg.n_layers}L, vocab {cfg.vocab_size})")
+    else:
+        if not args.checkpoint_dir:
+            print("serve needs --checkpoint-dir (or --demo)",
+                  file=sys.stderr)
+            return 2
+        restored = _restore_decode_model(args)
+        if isinstance(restored, int):
+            return restored
+        cfg, params = restored
+
+    engine = ServingEngine(
+        cfg, params,
+        n_slots=args.slots,
+        max_total=args.max_total,
+        temperature=args.temperature,
+        top_k=args.top_k if args.top_k > 0 else None,
+        scheduler=RequestScheduler(max_queue_depth=args.max_queue),
+        rng_seed=args.seed,
+    )
+    server = ServingServer(engine, host=args.host, port=args.port)
+    host, port = server.address
+    print(f"serving on http://{host}:{port}  "
+          f"({args.slots} slots, {engine.max_total} tokens/slot, "
+          f"queue depth {args.max_queue})")
+    server.serve_forever()
     return 0
 
 
@@ -554,6 +622,44 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("--n-experts", type=int, default=0)
     g.add_argument("--bf16", action="store_true")
     g.set_defaults(fn=cmd_generate)
+
+    v = sub.add_parser(
+        "serve",
+        help="continuous-batching HTTP serving engine over a trained "
+        "checkpoint (POST /v1/generate; --demo for a random-init model)",
+    )
+    v.add_argument("--checkpoint-dir", default=None)
+    v.add_argument(
+        "--checkpoint-backend", default="npz", choices=["npz", "orbax"],
+    )
+    v.add_argument("--demo", action="store_true",
+                   help="serve a random-init model (no checkpoint)")
+    v.add_argument("--host", default="127.0.0.1")
+    v.add_argument("--port", type=int, default=8080)
+    v.add_argument("--slots", type=int, default=8,
+                   help="decode slots = max concurrent requests in flight")
+    v.add_argument("--max-total", type=int, default=None,
+                   help="token budget per slot (prompt+generation; "
+                   "default: the model's max_len)")
+    v.add_argument("--max-queue", type=int, default=128,
+                   help="queued requests beyond which submits get 429")
+    v.add_argument("--temperature", type=float, default=0.8)
+    v.add_argument("--top-k", type=int, default=40,
+                   help="0 disables top-k filtering")
+    v.add_argument("--seed", type=int, default=0)
+    v.add_argument(
+        "--int8", default="off", choices=["off", "weights", "full"],
+        help="weight-only int8 or the fully quantized path (int8 KV "
+        "cache) — PERF.md r5",
+    )
+    # model flags for --demo / pre-config checkpoints
+    v.add_argument("--seq-len", type=int, default=128)
+    v.add_argument("--d-model", type=int, default=128)
+    v.add_argument("--n-layers", type=int, default=2)
+    v.add_argument("--n-heads", type=int, default=4)
+    v.add_argument("--n-experts", type=int, default=0)
+    v.add_argument("--bf16", action="store_true")
+    v.set_defaults(fn=cmd_serve)
 
     # add_help=False so `bench -h` reaches bench.py's parser, which
     # documents --model/--batch/--dtype

@@ -701,6 +701,41 @@ def _decode_builder(cfg: TransformerConfig):
         vs bf16 cache is ~0.3% on random models."""
         return _quantize_int8(rows.astype(jnp.float32), (-1,))
 
+    def write_kv_rows(kv_all, i, pos, kv_row):
+        """Write one decode step's K/V rows into the stacked cache at
+        layer ``i``. ``kv_row``: (1, 2, B, 1, Hkv*K). Scalar ``pos``
+        writes every batch row at the same position with a single fused
+        ``dynamic_update_slice`` (the generate/beam path — XLA aliases
+        it in place); an (B,) vector scatters each row at its own
+        position (the serving engine's per-slot decode depths)."""
+        if jnp.ndim(pos) == 0:
+            if cfg.decode_int8:
+                kv_buf, sc_buf = kv_all["kv"], kv_all["scale"]
+                q_row, s_row = quantize_kv_rows(kv_row)
+                kv_buf = lax.dynamic_update_slice(
+                    kv_buf, q_row, (i, 0, 0, pos, 0)
+                )
+                sc_buf = lax.dynamic_update_slice(
+                    sc_buf, s_row, (i, 0, 0, pos, 0)
+                )
+                return {"kv": kv_buf, "scale": sc_buf}
+            return lax.dynamic_update_slice(
+                kv_all, kv_row.astype(kv_all.dtype), (i, 0, 0, pos, 0)
+            )
+        rows = kv_row[0, :, :, 0, :]  # (2, B, Hkv*K)
+        bidx = jnp.arange(rows.shape[1])
+        if cfg.decode_int8:
+            kv_buf, sc_buf = kv_all["kv"], kv_all["scale"]
+            q_rows, s_rows = quantize_kv_rows(rows)
+            for plane in range(2):
+                kv_buf = kv_buf.at[i, plane, bidx, pos].set(q_rows[plane])
+                sc_buf = sc_buf.at[i, plane, bidx, pos].set(s_rows[plane])
+            return {"kv": kv_buf, "scale": sc_buf}
+        rows = rows.astype(kv_all.dtype)
+        for plane in range(2):
+            kv_all = kv_all.at[i, plane, bidx, pos].set(rows[plane])
+        return kv_all
+
     def block_decode(x, p, kv_all, i, pos):
         # x: (B, D) one position; kv_all: the ONE stacked packed cache
         # (nl, 2, B, Tpad, Hkv*K) (axis 1: K then V) — this layer writes
@@ -734,28 +769,23 @@ def _decode_builder(cfg: TransformerConfig):
             )
             q, k, v = qkv[0], qkv[1], qkv[2]
         if cfg.rope:
-            cos, sin = _rope_tables(pos, cfg.head_dim, x.dtype)  # (hd/2,)
-            q = _apply_rope(q, cos[None, None], sin[None, None])
-            k = _apply_rope(k, cos[None, None], sin[None, None])
+            cos, sin = _rope_tables(pos, cfg.head_dim, x.dtype)
+            if jnp.ndim(pos) == 1:
+                # per-slot positions (serving): (B, hd/2) tables, one
+                # rotation per batch row
+                cos, sin = cos[:, None, :], sin[:, None, :]
+            else:
+                cos, sin = cos[None, None], sin[None, None]  # (hd/2,)
+            q = _apply_rope(q, cos, sin)
+            k = _apply_rope(k, cos, sin)
         kv_row = jnp.stack(
             [k.reshape(b, -1), v.reshape(b, -1)]
         )[None, :, :, None, :]  # (1, 2, B, 1, Hkv*K)
+        kv_all = write_kv_rows(kv_all, i, pos, kv_row)
         if cfg.decode_int8:
             kv_buf, sc_buf = kv_all["kv"], kv_all["scale"]
-            q_row, s_row = quantize_kv_rows(kv_row)
-            kv_buf = lax.dynamic_update_slice(
-                kv_buf, q_row, (i, 0, 0, pos, 0)
-            )
-            sc_buf = lax.dynamic_update_slice(
-                sc_buf, s_row, (i, 0, 0, pos, 0)
-            )
-            kv_all = {"kv": kv_buf, "scale": sc_buf}
         else:
             kv_buf, sc_buf = kv_all, None
-            kv_buf = lax.dynamic_update_slice(
-                kv_buf, kv_row.astype(kv_buf.dtype), (i, 0, 0, pos, 0)
-            )
-            kv_all = kv_buf
         from deeplearning4j_tpu.ops.pallas_kernels import (
             flash_decode_attention,
         )
@@ -803,13 +833,23 @@ def _decode_builder(cfg: TransformerConfig):
     def forward_one(params, caches, token, pos):
         """One position through all layers; returns (logits, caches).
 
+        ``pos`` is a scalar (every batch row at the same depth — the
+        generate/beam/speculative paths) or an (B,) int vector of
+        per-row positions (the serving engine, where each slot decodes
+        at its own depth).
+
         The layer loop is UNROLLED (n_layers static python loop): the
         round-1 lax.scan spent a third of decode wall time in while-loop
         bookkeeping alone (measured via hlo_stats), and its cache carry
         defeated in-place updates.
         """
         kv_all = caches
-        x = (params["embed"][token] + params["pos"][pos]).astype(
+        # explicit clamp, matching forward_chunk's mode='clip': the
+        # speculative draft legitimately calls at pos up to total+k-2
+        # (scratch slots whose outputs are discarded) and must not rely
+        # on XLA's implicit out-of-bounds gather clamping
+        emb_pos = jnp.minimum(pos, cfg.max_len - 1)
+        x = (params["embed"][token] + params["pos"][emb_pos]).astype(
             cfg.compute_dtype
         )
         for i in range(cfg.n_layers):
@@ -1164,18 +1204,24 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0):
     implementation serving both ``block_decode``'s non-kernel path
     (C=1) and the speculative verify chunk — the dense decode numerics
     cannot drift from the verify numerics because they are the same
-    code."""
+    code. ``pos0`` is a scalar start position or an (B,) vector of
+    per-row starts (the serving engine's per-slot decode depths)."""
     b, c, _ = x.shape
     kd = cfg.head_dim
     grp = cfg.n_heads // cfg.kv_heads
+    vec_pos = jnp.ndim(pos0) == 1
+    # (C,) shared positions, or (B, C) per-row positions
+    positions = (pos0[:, None] if vec_pos else pos0) + jnp.arange(c)
     h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     q, k_r, v_r = _project_qkv(cfg, p, h_in)  # (B,H,C,K), (B,Hkv,C,K)
     if cfg.rope:
-        cos, sin = _rope_tables(
-            pos0 + jnp.arange(c), cfg.head_dim, x.dtype
-        )  # (C, hd/2)
-        q = _apply_rope(q, cos[None, None], sin[None, None])
-        k_r = _apply_rope(k_r, cos[None, None], sin[None, None])
+        cos, sin = _rope_tables(positions, cfg.head_dim, x.dtype)
+        if vec_pos:  # (B, C, hd/2): per-row tables over the head axis
+            cos, sin = cos[:, None], sin[:, None]
+        else:  # (C, hd/2)
+            cos, sin = cos[None, None], sin[None, None]
+        q = _apply_rope(q, cos, sin)
+        k_r = _apply_rope(k_r, cos, sin)
     kv_rows = jnp.stack(
         [
             k_r.transpose(0, 2, 1, 3).reshape(b, c, -1),
@@ -1187,21 +1233,39 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0):
         q_rows, s_rows = _quantize_int8(
             kv_rows.astype(jnp.float32), (-1,)
         )
-        kv_buf = lax.dynamic_update_slice(
-            kv_buf, q_rows, (i, 0, 0, pos0, 0)
-        )
-        sc_buf = lax.dynamic_update_slice(
-            sc_buf, s_rows, (i, 0, 0, pos0, 0)
-        )
+        if vec_pos:
+            bidx = jnp.arange(b)[:, None]
+            for plane in range(2):
+                kv_buf = kv_buf.at[i, plane, bidx, positions].set(
+                    q_rows[0, plane]
+                )
+                sc_buf = sc_buf.at[i, plane, bidx, positions].set(
+                    s_rows[0, plane]
+                )
+        else:
+            kv_buf = lax.dynamic_update_slice(
+                kv_buf, q_rows, (i, 0, 0, pos0, 0)
+            )
+            sc_buf = lax.dynamic_update_slice(
+                sc_buf, s_rows, (i, 0, 0, pos0, 0)
+            )
         kv_all = {"kv": kv_buf, "scale": sc_buf}
         ck = (kv_buf[i, 0].astype(jnp.float32)
               * sc_buf[i, 0]).astype(x.dtype)
         cv = (kv_buf[i, 1].astype(jnp.float32)
               * sc_buf[i, 1]).astype(x.dtype)
     else:
-        kv_all = lax.dynamic_update_slice(
-            kv_all, kv_rows.astype(kv_all.dtype), (i, 0, 0, pos0, 0)
-        )
+        if vec_pos:
+            bidx = jnp.arange(b)[:, None]
+            rows = kv_rows.astype(kv_all.dtype)
+            for plane in range(2):
+                kv_all = kv_all.at[i, plane, bidx, positions].set(
+                    rows[0, plane]
+                )
+        else:
+            kv_all = lax.dynamic_update_slice(
+                kv_all, kv_rows.astype(kv_all.dtype), (i, 0, 0, pos0, 0)
+            )
         ck, cv = kv_all[i, 0], kv_all[i, 1]
     tpad = ck.shape[1]
     ck4 = ck.reshape(b, tpad, cfg.kv_heads, kd)
@@ -1210,11 +1274,12 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0):
     att = jnp.einsum(
         "bhgck,bthk->bhgct", qg, ck4
     ) / jnp.sqrt(kd).astype(x.dtype)
-    mask = (
-        jnp.arange(tpad)[None, :]
-        <= (pos0 + jnp.arange(c))[:, None]
-    )  # (C, Tpad) causal against the cache
-    att = jnp.where(mask[None, None, None], att, -jnp.inf)
+    # causal against the cache: (C, Tpad) shared, or (B, C, Tpad)
+    mask = jnp.arange(tpad)[None, :] <= positions[..., None]
+    att = jnp.where(
+        mask[:, None, None] if vec_pos else mask[None, None, None], att,
+        -jnp.inf,
+    )
     w_att = jax.nn.softmax(att, axis=-1)
     o = jnp.einsum("bhgct,bthk->bhgck", w_att, cv4)
     o_flat = o.transpose(0, 3, 1, 2, 4).reshape(

@@ -446,7 +446,7 @@ class Word2Vec:
         pair-batch and the parameter *deltas* are averaged — reproducing the
         master-side delta merge (Word2VecJobAggregator.java:23-36) as an
         in-graph pmean over the mesh."""
-        from jax import shard_map
+        from deeplearning4j_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from deeplearning4j_tpu.parallel import mesh as mesh_lib

@@ -664,8 +664,10 @@ def flash_decode_attention(
     way it fuses one feeding an einsum: 521us/step at GPT-2-small,
     measured.) T must be a multiple of ``block_t`` (callers pad; rows
     beyond ``pos`` are masked so padding is free). ``pos``: scalar
-    int32, the position being decoded — rows > pos are invisible.
-    Returns (B, G, Hkv*K) attention output in q's dtype.
+    int32, the position being decoded, or an (B,) vector of per-row
+    positions (continuous-batching serving, where each slot decodes at
+    its own depth) — rows > pos are invisible. Returns (B, G, Hkv*K)
+    attention output in q's dtype.
 
     ``kv_scales`` (int8 serving mode): per-row dequant scales
     (n_layers, 2, B, T, 1) f32 for an int8 ``kvcache`` — rows convert
@@ -685,7 +687,7 @@ def flash_decode_attention(
         # is the ~16MB scoped VMEM budget: the K and V block planes,
         # double-buffered by the pipeline, are the dominant allocation
         # (a single 8704-row bf16 block OOMed at 17.04M, matching the
-        # 4-plane estimate), so cap rows at ~12MB / (hk * itemsize * 4)
+        # 4-plane estimate), so cap rows at 14MiB / (hk * eff_bytes * 4)
         # with headroom for q/out/scratch. int8 caches stream half the
         # HBM bytes but the kernel's in-register conversion keeps extra
         # per-block scratch: the measured single-block int8 OOM
@@ -717,7 +719,12 @@ def flash_decode_attention(
         n_kv_heads=n_kv_heads, head_dim=head_dim, groups=g,
         scale=1.0 / (head_dim**0.5), quantized=quantized,
     )
-    pos_arr = jnp.reshape(pos, (1, 1)).astype(jnp.int32)
+    # (B, 1) per-row positions: a scalar pos broadcasts to every row, a
+    # (B,) vector (serving) keeps per-slot depths. The kernel reads its
+    # row's block via the batch-indexed BlockSpec below.
+    pos_arr = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(pos, jnp.int32), (-1, 1)), (b, 1)
+    )
     if pltpu is not None and not interpret:
         params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
@@ -736,7 +743,7 @@ def flash_decode_attention(
             (1, 1, 1, block_t, hk),
             lambda i, tt: (layer, 1, i, tt, 0),
         ),
-        pl.BlockSpec((1, 1), lambda i, tt: (0, 0)),
+        pl.BlockSpec((1, 1), lambda i, tt: (i, 0)),
     ]
     operands = [q, kvcache, kvcache, pos_arr]
     if quantized:

@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from deeplearning4j_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deeplearning4j_tpu.parallel import mesh as mesh_lib

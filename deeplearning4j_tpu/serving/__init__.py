@@ -1,0 +1,32 @@
+"""Continuous-batching serving engine on top of the transformer decode
+stack: iteration-level scheduling (Orca, OSDI '22; vLLM, SOSP '23) over
+a fixed-shape batch of KV-cache slots.
+
+Public surface:
+
+- :class:`~deeplearning4j_tpu.serving.scheduler.Request` /
+  :class:`~deeplearning4j_tpu.serving.scheduler.RequestScheduler` —
+  admission-controlled priority queue with backpressure.
+- :class:`~deeplearning4j_tpu.serving.cache_pool.KVSlotPool` — slot
+  recycling over one pre-allocated ``init_caches`` buffer.
+- :class:`~deeplearning4j_tpu.serving.engine.ServingEngine` — the
+  continuous-batching decode loop (admit / fused step / retire).
+- :class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics` —
+  TTFT/TPOT/occupancy/queue-depth with p50/p99 summaries.
+- :class:`~deeplearning4j_tpu.serving.server.ServingServer` — stdlib
+  HTTP-JSON front end.
+"""
+
+from deeplearning4j_tpu.serving.cache_pool import KVSlotPool  # noqa: F401
+from deeplearning4j_tpu.serving.engine import (  # noqa: F401
+    ServingEngine,
+    run_request_trace,
+)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
+    AdmissionError,
+    Backpressure,
+    Request,
+    RequestScheduler,
+)
+from deeplearning4j_tpu.serving.server import ServingServer  # noqa: F401

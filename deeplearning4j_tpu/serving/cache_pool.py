@@ -1,0 +1,77 @@
+"""KV-slot pool: slot recycling over ONE pre-allocated decode cache.
+
+The batch axis of ``_decode_builder.init_caches`` IS the slot pool: the
+buffers — (n_layers, 2, n_slots, Tpad, Hkv*K), plus the f32 scale
+planes in int8 mode — are allocated once at engine start and never
+re-allocated. Admitting a request into a freed slot overwrites that
+slot's rows (the prefill insert copies a full Tpad slab, zeros beyond
+the prompt, so no stale rows from the previous occupant survive);
+releasing a slot is pure free-list bookkeeping, no device work. This is
+the fixed-slot special case of vLLM's paged pool: one page per request,
+sized to the engine's token budget.
+
+Slots are handed out lowest-index-first so admission order is
+deterministic — tests (and trace replays) rely on it.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import jax
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    _decode_builder,
+)
+
+
+class KVSlotPool:
+    """Free-list of decode-cache slots over one device allocation.
+
+    ``caches`` is the live pytree (an array, or ``{"kv", "scale"}`` in
+    int8-cache mode). The engine's jitted steps consume and return it
+    functionally; with buffer donation the update is in place.
+    """
+
+    def __init__(self, cfg: TransformerConfig, n_slots: int, max_total: int):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        _, init_caches, _, _ = _decode_builder(cfg)
+        self.caches = init_caches(n_slots, max_total)
+        kv = self.caches["kv"] if isinstance(self.caches, dict) else self.caches
+        self.n_slots = n_slots
+        self.tpad = kv.shape[3]  # rounded-up row count per slot
+        self._free = list(range(n_slots))  # already a heap
+        self._in_use: set[int] = set()
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._in_use)
+
+    @property
+    def occupancy(self) -> float:
+        """Active fraction of the slot batch this instant, in [0, 1]."""
+        return len(self._in_use) / self.n_slots
+
+    def acquire(self) -> int:
+        """Claim the lowest free slot index."""
+        if not self._free:
+            raise RuntimeError("no free KV slots")
+        slot = heapq.heappop(self._free)
+        self._in_use.add(slot)
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not in use")
+        self._in_use.remove(slot)
+        heapq.heappush(self._free, slot)
+
+    def nbytes(self) -> int:
+        """Device bytes of the pooled cache (all slots)."""
+        return sum(x.nbytes for x in jax.tree.leaves(self.caches))

@@ -1,0 +1,303 @@
+"""Continuous-batching decode engine.
+
+Iteration-level scheduling (Orca, OSDI '22): instead of batching whole
+requests, the engine batches individual DECODE STEPS. It owns a
+fixed-shape batch of ``n_slots`` KV-cache slots (one pooled
+``init_caches`` allocation, see :mod:`cache_pool`); every
+``step()``:
+
+1. retires slots whose request hit EOS or its ``max_new`` budget
+   (host-side bookkeeping only — the slot's rows are simply reused);
+2. admits queued requests into freed slots: a per-prompt-length jitted
+   prefill runs at batch 1 and its cache rows are inserted into the
+   pooled buffers at the slot index (so a long prefill never stalls at
+   the batch shape of the decode loop);
+3. runs ONE fused decode step for all slots — sampling each slot's next
+   token from its pending logits, then ``forward_one`` with a PER-SLOT
+   position vector. Inactive slots decode a dummy token at their stale
+   position so the program shape never changes (their rows are fully
+   overwritten by the next admission's prefill insert, which copies a
+   whole Tpad slab).
+
+jit stability: exactly one compiled step program per engine (plus one
+prefill program per distinct prompt length). All per-slot state that
+the device touches — positions, active mask, pending logits — is
+passed as arrays; scheduling decisions happen on host between steps.
+
+Greedy determinism: at ``temperature=0`` the engine samples via the
+same ``_top_k_filter`` + argmax the plain ``transformer_generate`` path
+uses, and the decode math is row-/padding-invariant (masked cache rows
+contribute exact zeros), so token streams are byte-identical to running
+each request alone — ``tests/test_serving.py`` asserts this.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    _decode_builder,
+    _top_k_filter,
+)
+from deeplearning4j_tpu.serving.cache_pool import KVSlotPool
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.scheduler import Request, RequestScheduler
+
+
+class _SlotState:
+    """Host-side record for one active slot."""
+
+    __slots__ = ("req", "tokens", "t_first_token")
+
+    def __init__(self, req: Request):
+        self.req = req
+        self.tokens: list[int] = []
+        self.t_first_token: float | None = None
+
+
+class ServingEngine:
+    """Fixed-shape continuous-batching decode loop.
+
+    ``params`` may be float or ``quantize_decode_params`` output (pair
+    with ``cfg.decode_int8=True`` for the int8 KV cache). Sampling
+    settings are engine-wide (they are baked into the compiled step):
+    ``temperature=0`` decodes greedily.
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params,
+        *,
+        n_slots: int = 8,
+        max_total: int | None = None,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        approx_top_k: bool = False,
+        scheduler: RequestScheduler | None = None,
+        metrics: ServingMetrics | None = None,
+        rng_seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_total = int(min(max_total or cfg.max_len, cfg.max_len))
+        self.temperature = temperature
+        self.top_k = top_k
+        self.approx_top_k = approx_top_k
+
+        fwd1, init_caches, do_prefill, cast_params = _decode_builder(cfg)
+        self._fwd1 = fwd1
+        self._init_caches = init_caches
+        self._do_prefill = do_prefill
+        # one-time weight cast (generate does this inside its jitted
+        # program; hoisting it out of the per-step program keeps every
+        # step from re-casting — same values, cast is deterministic)
+        self.params = jax.jit(cast_params)(params)
+
+        self.pool = KVSlotPool(cfg, n_slots, self.max_total)
+        self.scheduler = scheduler or RequestScheduler(
+            max_total_tokens=self.max_total
+        )
+        if self.scheduler.max_total_tokens is None:
+            self.scheduler.max_total_tokens = self.max_total
+        self.metrics = metrics or ServingMetrics()
+
+        # pending next-token logits per slot (f32, written by prefill
+        # on admission and by every decode step)
+        self._logits = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
+        self._pos = np.zeros((n_slots,), np.int32)
+        self._active = np.zeros((n_slots,), bool)
+        self._slots: list[_SlotState | None] = [None] * n_slots
+        self._results: dict[str, np.ndarray] = {}
+        self._key = jax.random.key(rng_seed)
+        self._steps = 0
+
+        # donating the cache + logits lets XLA update them in place
+        # (the cache is the dominant allocation); CPU jit can't alias
+        # donated buffers and would warn every call
+        donate = (1, 2) if jax.devices()[0].platform == "tpu" else ()
+        self._step_fn = jax.jit(self._build_step(), donate_argnums=donate)
+        self._prefill_fns: dict[int, object] = {}
+        self._prefill_donate = donate
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build_step(self):
+        fwd1 = self._fwd1
+        temperature, top_k = self.temperature, self.top_k
+        approx_top_k = self.approx_top_k
+
+        def step(params, caches, logits, pos, active, key):
+            filt = _top_k_filter(logits, top_k, approx_top_k)
+            if temperature == 0:
+                toks = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+            else:
+                toks = jax.random.categorical(
+                    key, filt / temperature, axis=-1
+                ).astype(jnp.int32)
+            # inactive slots decode token 0 at their stale position —
+            # shape stability; the garbage rows they write are dead
+            # (admission prefill rewrites the whole slot slab)
+            toks = jnp.where(active, toks, 0)
+            new_logits, caches = fwd1(params, caches, toks, pos)
+            return caches, new_logits, toks
+
+        return step
+
+    def _prefill_into_slot(self, length: int):
+        """Jitted prefill-at-batch-1 + row insert, one program per
+        distinct prompt length."""
+        fn = self._prefill_fns.get(length)
+        if fn is None:
+            do_prefill = self._do_prefill
+            init_caches = self._init_caches
+            max_total = self.max_total
+
+            def prefill(params, caches, logits, prompt, slot):
+                # batch-1 prefill into a scratch single-slot cache of
+                # the SAME Tpad as the pool, then insert the slab at
+                # the slot index. The slab copy includes the zero rows
+                # beyond the prompt — that wipes the previous
+                # occupant's rows, so no stale state survives reuse.
+                tmp, lg = do_prefill(params, init_caches(1, max_total), prompt)
+                caches = jax.tree.map(
+                    lambda c, t: lax.dynamic_update_slice(
+                        c, t, (0, 0, slot, 0, 0)
+                    ),
+                    caches, tmp,
+                )
+                logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
+                return caches, logits
+
+            fn = jax.jit(prefill, donate_argnums=self._prefill_donate)
+            self._prefill_fns[length] = fn
+        return fn
+
+    # -- host-side loop ----------------------------------------------------
+
+    def submit(self, req: Request) -> str:
+        """Queue a request (see ``RequestScheduler.submit`` for the
+        backpressure/admission contract)."""
+        return self.scheduler.submit(req)
+
+    @property
+    def results(self) -> dict[str, np.ndarray]:
+        """Finished streams by request id: prompt + generated tokens."""
+        return self._results
+
+    @property
+    def idle(self) -> bool:
+        return not self._active.any() and len(self.scheduler) == 0
+
+    def _admit(self) -> None:
+        while self.pool.n_free and len(self.scheduler):
+            req = self.scheduler.pop()
+            slot = self.pool.acquire()
+            prompt = jnp.asarray(req.prompt[None, :], jnp.int32)
+            fn = self._prefill_into_slot(len(req.prompt))
+            self.pool.caches, self._logits = fn(
+                self.params, self.pool.caches, self._logits, prompt,
+                jnp.int32(slot),
+            )
+            self._pos[slot] = len(req.prompt)
+            self._active[slot] = True
+            self._slots[slot] = _SlotState(req)
+
+    def _finish(self, slot: int, now: float) -> None:
+        st = self._slots[slot]
+        req = st.req
+        self._results[req.id] = np.concatenate(
+            [req.prompt, np.asarray(st.tokens, np.int32)]
+        )
+        self.metrics.record_finished(
+            req.id, len(st.tokens),
+            now - (st.t_first_token or now),
+        )
+        self.pool.release(slot)
+        self._active[slot] = False
+        self._slots[slot] = None
+        if req.done is not None:
+            req.done.set()
+
+    def step(self) -> bool:
+        """Admit waiting requests, run one fused decode step, retire
+        finished slots. Returns False when there was nothing to do."""
+        self._admit()
+        if not self._active.any():
+            return False
+        n_active = int(self._active.sum())
+        self._key, sub = jax.random.split(self._key)
+        caches, logits, toks = self._step_fn(
+            self.params, self.pool.caches, self._logits,
+            jnp.asarray(self._pos), jnp.asarray(self._active), sub,
+        )
+        self.pool.caches, self._logits = caches, logits
+        toks_host = np.asarray(toks)  # the one host sync per step
+        now = time.perf_counter()
+        self._steps += 1
+        for slot in np.flatnonzero(self._active):
+            st = self._slots[slot]
+            tok = int(toks_host[slot])
+            if st.t_first_token is None:
+                st.t_first_token = now
+                self.metrics.record_first_token(
+                    st.req.id, now - st.req.arrival_time
+                )
+            st.tokens.append(tok)
+            self._pos[slot] += 1
+            if (len(st.tokens) >= st.req.max_new
+                    or tok == st.req.eos_token):
+                self._finish(int(slot), now)
+        self.metrics.record_step(
+            n_active, self.n_slots, len(self.scheduler)
+        )
+        return True
+
+    def run(self, max_steps: int | None = None) -> dict[str, np.ndarray]:
+        """Step until every queued/active request finishes."""
+        steps = 0
+        while not self.idle:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self._results
+
+
+def run_request_trace(
+    engine: ServingEngine,
+    trace: list[tuple[float, Request]],
+    *,
+    time_scale: float = 1.0,
+) -> dict[str, np.ndarray]:
+    """Replay an arrival trace against a live engine.
+
+    ``trace``: (arrival_offset_seconds, request) pairs; offsets are
+    relative to the replay start and scaled by ``time_scale`` (0 floods
+    every request instantly — useful for deterministic tests). The
+    engine keeps stepping while waiting, exactly as a serving loop
+    would, so admissions interleave with in-flight decodes.
+    """
+    order = sorted(range(len(trace)), key=lambda j: trace[j][0])
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(order) or not engine.idle:
+        now = time.perf_counter() - t0
+        while i < len(order):
+            t_arr, req = trace[order[i]]
+            if t_arr * time_scale > now:
+                break
+            engine.submit(req)
+            i += 1
+        if not engine.step() and i < len(order):
+            # idle engine, next arrival still in the future
+            time.sleep(
+                min(0.001, max(0.0, trace[order[i]][0] * time_scale - now))
+            )
+    return engine.results

@@ -1,0 +1,92 @@
+"""Serving latency/utilization metrics.
+
+Records through the existing JSONL :class:`MetricsWriter` (same format
+the trainer's listener emits, so the same grep/plot tooling reads both)
+and keeps in-memory series for percentile summaries:
+
+- ``serve/ttft_seconds`` — time-to-first-token per request, measured
+  from scheduler arrival (so queue wait counts — that is the number a
+  user sees);
+- ``serve/tpot_seconds`` — time-per-output-token per request over its
+  decode phase (steps after the first token);
+- ``serve/occupancy`` — ACTIVE SLOT COUNT per engine step (the
+  effective decode batch; > 1 means batching actually interleaved
+  requests), with the fraction as ``serve/occupancy_frac``;
+- ``serve/queue_depth`` — queued (not yet admitted) requests, sampled
+  per engine step.
+
+p50/p99 come from ``summary()``; with fewer than ~100 samples the p99
+is just the max-ish tail order statistic — fine for a bench row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.utils.metrics import MetricsWriter
+
+
+def _pct(xs: list[float], p: float) -> float:
+    return float(np.percentile(np.asarray(xs, np.float64), p))
+
+
+class ServingMetrics:
+    def __init__(self, writer: MetricsWriter | None = None,
+                 prefix: str = "serve"):
+        self.writer = writer
+        self.prefix = prefix
+        self.ttft: list[float] = []
+        self.tpot: list[float] = []
+        self.occupancy: list[float] = []
+        self.queue_depth: list[int] = []
+        self.n_finished = 0
+        self.n_generated = 0
+        self._step = 0
+
+    def _emit(self, tag: str, value: float, step: int | None = None) -> None:
+        if self.writer is not None:
+            self.writer.scalar(f"{self.prefix}/{tag}", value, step)
+
+    def record_step(self, n_active: int, n_slots: int,
+                    queue_depth: int) -> None:
+        """Per-engine-step utilization sample (``n_active`` slots
+        decoding this step, of ``n_slots``)."""
+        self.occupancy.append(float(n_active))
+        self.queue_depth.append(int(queue_depth))
+        self._emit("occupancy", n_active, self._step)
+        self._emit("occupancy_frac", n_active / n_slots, self._step)
+        self._emit("queue_depth", queue_depth, self._step)
+        self._step += 1
+
+    def record_first_token(self, req_id: str, ttft_s: float) -> None:
+        self.ttft.append(float(ttft_s))
+        self._emit("ttft_seconds", ttft_s)
+
+    def record_finished(self, req_id: str, n_tokens: int,
+                        decode_s: float) -> None:
+        """Request retired: ``n_tokens`` generated, ``decode_s`` wall
+        seconds spent after the first token."""
+        self.n_finished += 1
+        self.n_generated += n_tokens
+        if n_tokens > 1:
+            tpot = decode_s / (n_tokens - 1)
+            self.tpot.append(tpot)
+            self._emit("tpot_seconds", tpot)
+
+    def summary(self) -> dict:
+        """Aggregate view: p50/p99 latencies + mean utilization."""
+        out = {
+            "n_finished": self.n_finished,
+            "n_generated": self.n_generated,
+            "steps": self._step,
+        }
+        for name, xs in [("ttft", self.ttft), ("tpot", self.tpot)]:
+            if xs:
+                out[f"{name}_p50_s"] = _pct(xs, 50)
+                out[f"{name}_p99_s"] = _pct(xs, 99)
+        if self.occupancy:
+            # mean slots actually decoding per step — the "effective
+            # batch" a continuous batcher is supposed to keep > 1
+            out["occupancy_mean"] = float(np.mean(self.occupancy))
+            out["queue_depth_max"] = int(max(self.queue_depth))
+        return out

@@ -1,0 +1,122 @@
+"""Request queue for the serving engine.
+
+FIFO within priority classes, strict priority across classes (class 0
+drains before class 1, etc. — the simple strict policy; weighted-fair
+would go here if starvation ever matters). Admission control happens at
+``submit`` time, not dequeue time, so a caller holding a rejected
+request knows immediately:
+
+- ``Backpressure`` when the queue is at ``max_queue_depth`` — the HTTP
+  front end maps this to 429 so load sheds at the edge instead of
+  growing an unbounded in-process queue;
+- ``AdmissionError`` when the request's token budget
+  (``len(prompt) + max_new``) cannot fit the engine's cache slots at
+  all — queueing it would deadlock the admission loop, since no slot
+  will ever be big enough.
+
+Thread-safe: the HTTP handler threads ``submit`` while the engine
+thread ``pop``s.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+
+class Backpressure(RuntimeError):
+    """Queue at max depth — shed load upstream (HTTP 429)."""
+
+
+class AdmissionError(ValueError):
+    """Request can never be served (token budget exceeds slot size)."""
+
+
+_ids = itertools.count()
+
+
+def _next_id() -> str:
+    return f"req-{next(_ids)}"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt`` is a 1-D int token array; ``max_new`` bounds generation;
+    ``eos_token`` (optional) retires the slot early. ``priority`` 0 is
+    most urgent. ``arrival_time`` is stamped by the scheduler at submit
+    (perf_counter domain) and anchors TTFT.
+    """
+
+    prompt: np.ndarray
+    max_new: int
+    priority: int = 1
+    eos_token: int | None = None
+    id: str = dataclasses.field(default_factory=_next_id)
+    arrival_time: float | None = None
+    # set by the HTTP front end: signaled when the engine retires the
+    # request, so a blocked handler thread can return the result
+    done: threading.Event | None = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.max_new < 1:
+            raise AdmissionError(f"max_new must be >= 1, got {self.max_new}")
+
+
+class RequestScheduler:
+    """Bounded multi-priority FIFO with admission control."""
+
+    def __init__(
+        self,
+        max_queue_depth: int = 128,
+        max_total_tokens: int | None = None,
+        n_priorities: int = 3,
+    ):
+        self.max_queue_depth = max_queue_depth
+        self.max_total_tokens = max_total_tokens
+        self._queues = [deque() for _ in range(n_priorities)]
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues)
+
+    @property
+    def depth(self) -> int:
+        return len(self)
+
+    def submit(self, req: Request) -> str:
+        """Enqueue ``req``; returns its id. Raises ``Backpressure`` /
+        ``AdmissionError`` (see module docstring)."""
+        total = len(req.prompt) + req.max_new
+        if self.max_total_tokens is not None and total > self.max_total_tokens:
+            raise AdmissionError(
+                f"request {req.id}: prompt+max_new ({total}) exceeds the "
+                f"per-slot token budget ({self.max_total_tokens})"
+            )
+        if not 0 <= req.priority < len(self._queues):
+            raise AdmissionError(
+                f"priority {req.priority} outside [0, {len(self._queues)})"
+            )
+        with self._lock:
+            if len(self) >= self.max_queue_depth:
+                raise Backpressure(
+                    f"queue at max depth ({self.max_queue_depth})"
+                )
+            req.arrival_time = time.perf_counter()
+            self._queues[req.priority].append(req)
+        return req.id
+
+    def pop(self) -> Request | None:
+        """Highest-priority, oldest request — or None when idle."""
+        with self._lock:
+            for q in self._queues:
+                if q:
+                    return q.popleft()
+        return None

@@ -1,0 +1,163 @@
+"""HTTP-JSON front end for the serving engine.
+
+Thin by design: the stdlib ``ThreadingHTTPServer`` + the shared
+``utils.httpjson`` framing, one background thread running the engine
+loop. Handler threads block on the request's ``done`` event and return
+the finished stream — a synchronous completion API (no streaming; SSE
+would layer on the same engine callbacks).
+
+Endpoints:
+
+- ``POST /v1/generate`` — body ``{"prompt": [ints] | "text",
+  "max_new": int, "priority"?: int, "eos_token"?: int}``; returns
+  ``{"id", "tokens", "text"?}``. 429 on queue backpressure, 400 on a
+  request that can never fit a slot.
+- ``GET /metrics`` — ``ServingMetrics.summary()`` + live engine state.
+- ``GET /healthz`` — liveness.
+
+Text prompts/completions use the repo's byte-level convention
+(latin-1 per byte) and are only offered when ``vocab_size <= 256``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.scheduler import (
+    AdmissionError,
+    Backpressure,
+    Request,
+)
+from deeplearning4j_tpu.utils.httpjson import (
+    QuietHandler,
+    read_json_body,
+    send_json,
+)
+
+
+class ServingServer:
+    """Engine + HTTP front end; ``start()`` is non-blocking."""
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout_s: float = 300.0):
+        self.engine = engine
+        self.request_timeout_s = request_timeout_s
+        self._stop = threading.Event()
+        server = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    send_json(self, 200, {"ok": True})
+                elif self.path == "/metrics":
+                    send_json(self, 200, server._metrics_payload())
+                else:
+                    send_json(self, 404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    send_json(self, 404, {"error": "not found"})
+                    return
+                body = read_json_body(self)
+                if body is None:
+                    send_json(self, 400, {"error": "malformed JSON"})
+                    return
+                try:
+                    req = server._parse_request(body)
+                except (AdmissionError, ValueError, TypeError) as e:
+                    send_json(self, 400, {"error": str(e)})
+                    return
+                try:
+                    server.engine.submit(req)
+                except Backpressure as e:
+                    send_json(self, 429, {"error": str(e)})
+                    return
+                except AdmissionError as e:
+                    send_json(self, 400, {"error": str(e)})
+                    return
+                if not req.done.wait(server.request_timeout_s):
+                    send_json(self, 504, {"error": "generation timed out"})
+                    return
+                toks = server.engine.results[req.id].tolist()
+                out = {"id": req.id, "tokens": toks}
+                if server._byte_vocab():
+                    out["text"] = bytes(
+                        t % 256 for t in toks
+                    ).decode("latin-1")
+                send_json(self, 200, out)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, daemon=True
+        )
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def _byte_vocab(self) -> bool:
+        return self.engine.cfg.vocab_size <= 256
+
+    def _parse_request(self, body: dict) -> Request:
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            if not self._byte_vocab():
+                raise ValueError(
+                    "text prompts need a byte-level model (vocab <= 256)"
+                )
+            prompt = list(prompt.encode("latin-1", errors="replace"))
+        if not isinstance(prompt, list):
+            raise ValueError("'prompt' must be a token list or a string")
+        return Request(
+            prompt=prompt,
+            max_new=int(body.get("max_new", 16)),
+            priority=int(body.get("priority", 1)),
+            eos_token=(
+                int(body["eos_token"]) if "eos_token" in body else None
+            ),
+            done=threading.Event(),
+        )
+
+    def _metrics_payload(self) -> dict:
+        eng = self.engine
+        out = eng.metrics.summary()
+        out.update(
+            n_slots=eng.n_slots,
+            slots_active=eng.pool.n_active,
+            queue_depth=len(eng.scheduler),
+        )
+        return out
+
+    def _engine_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.engine.step():
+                # idle: nothing queued, nothing decoding
+                time.sleep(0.002)
+
+    def start(self) -> "ServingServer":
+        self._engine_thread.start()
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._engine_thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        """Blocking convenience for the CLI."""
+        self.start()
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()

@@ -1,0 +1,28 @@
+"""Version-compat shims for the jax API surface this repo uses.
+
+The codebase targets the modern jax API (``jax.shard_map`` with
+``check_vma=``); older jaxlib builds (< 0.6) ship the same machinery as
+``jax.experimental.shard_map.shard_map`` with the replication check
+spelled ``check_rep=``. Import ``shard_map`` from here instead of from
+``jax`` so every call site keeps the one modern spelling and the
+translation lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map_impl
+
+    _VMA_KWARG = "check_vma"
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _VMA_KWARG = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    """``jax.shard_map`` with the modern keyword signature on any jax."""
+    kwargs[_VMA_KWARG] = check_vma
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -287,7 +287,6 @@ def test_int8_weights_only_decode_over_bf16_cache():
     ``decode_int8=False`` must run the unmodified bf16 cache/kernel path
     — ``_w`` dequantizes by leaf dtype — and track the float reference
     as closely as the fully-quantized path does."""
-    import dataclasses
     import functools
 
     from deeplearning4j_tpu.models.transformer import (
@@ -552,7 +551,6 @@ def test_speculative_acceptance_efficiency_with_identical_draft():
     chunk — before it, every fully-accepted round left a permanent
     zero KV row (the sampled-but-never-fed d_k) in the draft cache,
     silently eroding acceptance while outputs stayed exact."""
-    import dataclasses
     import functools
 
     from deeplearning4j_tpu.models.transformer import (
