@@ -58,6 +58,15 @@ per workload — the driver's round record captures all of them:
                   version of the int8w latency win
 - ``transformer-flash-32k`` long-context training at T=32768 (B=1) —
                   the regime where dense attention cannot compile
+- ``transformer-decode-serve`` continuous-batching serving under a
+                  seeded pseudo-Poisson arrival trace (aggregate tok/s
+                  + TTFT p50/p99 + slot occupancy)
+- ``transformer-decode-serve-faults`` the same offered load with a
+                  seeded FaultInjector raising transient faults at a
+                  fixed 2% per-boundary rate: prices the supervised
+                  retry/backoff path and pins that throughput
+                  degradation under faults is bounded
+                  (``degradation_frac`` vs the clean replay in-row)
 
 ``--model X`` runs a single workload. ``--scaling`` reports 1->N-chip
 data-parallel efficiency (lenet/alexnet); ``--profile DIR`` captures an
@@ -732,7 +741,8 @@ def _bench_decode_spec(args):
 
 
 def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
-                        mean_interarrival_s: float = 0.01):
+                        mean_interarrival_s: float = 0.01,
+                        fault_rate: float = 0.0):
     """Continuous-batching serving under load: the GQA bf16 production
     decode geometry behind the ``ServingEngine``, driven by a
     DETERMINISTIC pseudo-Poisson arrival trace (seeded exponential
@@ -747,12 +757,21 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
     batch full). Aggregate tok/s lands below the steady-state
     ``transformer-decode-gqa`` rows by construction: the serving loop
     pays per-step host scheduling + admission prefills inside the
-    window, which is exactly the overhead this row exists to price."""
+    window, which is exactly the overhead this row exists to price.
+
+    With ``fault_rate > 0`` (the ``transformer-decode-serve-faults``
+    row) a seeded ``FaultInjector`` raises transient faults at engine
+    boundaries at that per-check probability; the supervised loop
+    retries with backoff, and the row reports the throughput next to
+    the clean number (``clean_tok_per_sec`` / ``degradation_frac``) —
+    the claim under test is that degradation at a fixed fault rate is
+    BOUNDED by retry backoff, not a stall or a crash."""
     import jax
     import numpy as np
 
     from deeplearning4j_tpu.models.transformer import init_transformer
     from deeplearning4j_tpu.serving import (
+        FaultInjector,
         Request,
         RequestScheduler,
         ServingEngine,
@@ -767,12 +786,16 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
         0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
     ).astype(np.int32)
 
-    def make_engine():
+    def make_engine(rate):
+        faults = (
+            FaultInjector(seed=1234, transient_rate=rate) if rate else None
+        )
         return ServingEngine(
             cfg, params, n_slots=n_slots,
             temperature=1.0, top_k=40,
             approx_top_k=not args.exact_top_k,
             scheduler=RequestScheduler(max_queue_depth=n_requests),
+            faults=faults, retry_backoff_s=0.002, max_backoff_s=0.05,
         )
 
     def make_trace():
@@ -782,8 +805,8 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
             for i in range(n_requests)
         ]
 
-    def replay():
-        engine = make_engine()
+    def replay(rate=0.0):
+        engine = make_engine(rate)
         trace = make_trace()
         t0 = time.perf_counter()
         results = run_request_trace(engine, trace)
@@ -793,7 +816,7 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
         return s["n_generated"] / dt, s
 
     replay()  # warmup: compiles the prefill + step programs
-    tok_per_sec, s = replay()
+    tok_per_sec, s = replay(fault_rate)
     extra = {
         "ttft_p50_s": round(s["ttft_p50_s"], 4),
         "ttft_p99_s": round(s["ttft_p99_s"], 4),
@@ -801,11 +824,21 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
         "n_slots": n_slots,
         "n_requests": n_requests,
     }
-    return (
-        tok_per_sec,
-        "transformer_gpt2s_h128_decode_serve_tokens_per_sec_per_chip",
-        extra,
-    )
+    metric = "transformer_gpt2s_h128_decode_serve_tokens_per_sec_per_chip"
+    if fault_rate:
+        clean_tok_per_sec, _ = replay()
+        extra.update(
+            fault_rate=fault_rate,
+            n_retries=s["n_retries"],
+            n_restarts=s["n_restarts"],
+            clean_tok_per_sec=round(clean_tok_per_sec, 1),
+            degradation_frac=round(
+                1.0 - tok_per_sec / clean_tok_per_sec, 4
+            ),
+        )
+        metric = ("transformer_gpt2s_h128_decode_serve_faults_"
+                  "tokens_per_sec_per_chip")
+    return tok_per_sec, metric, extra
 
 
 def _bench_resnet(args):
@@ -894,7 +927,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-b1", "transformer-decode-gqa-b1-int8w",
     "transformer-decode-gqa-b1-spec",
     "transformer-decode-gqa-8kctx", "transformer-decode-gqa-8kctx-int8",
-    "transformer-decode-serve",
+    "transformer-decode-serve", "transformer-decode-serve-faults",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -917,6 +950,7 @@ _AUTO_DTYPE = {
     "transformer-decode-gqa-8kctx": "bf16",
     "transformer-decode-gqa-8kctx-int8": "bf16",
     "transformer-decode-serve": "bf16",
+    "transformer-decode-serve-faults": "bf16",
 }
 
 
@@ -1025,10 +1059,17 @@ def _run_one_inner(args, jax) -> None:
     if args.model.startswith("transformer-decode"):
         if args.scaling:
             raise SystemExit("--scaling does not apply to decode")
-        if args.model == "transformer-decode-serve":
-            per_chip, metric, extra = _bench_decode_serve(args)
+        if args.model in ("transformer-decode-serve",
+                          "transformer-decode-serve-faults"):
+            # fixed injected transient-fault rate for the chaos row: high
+            # enough that retries demonstrably happen inside the window,
+            # low enough that the degradation bound is the story
+            rate = 0.02 if args.model.endswith("-faults") else 0.0
+            per_chip, metric, extra = _bench_decode_serve(
+                args, fault_rate=rate)
             _report(args, per_chip, metric, jax, extra=extra,
-                    remeasure=lambda: (_bench_decode_serve(args)[0], None))
+                    remeasure=lambda: (
+                        _bench_decode_serve(args, fault_rate=rate)[0], None))
             return
         if args.model.endswith("-spec"):
             per_chip, metric = _bench_decode_spec(args)

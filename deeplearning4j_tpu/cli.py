@@ -438,6 +438,7 @@ def cmd_serve(args) -> int:
     import jax
 
     from deeplearning4j_tpu.serving import (
+        FaultInjector,
         RequestScheduler,
         ServingEngine,
         ServingServer,
@@ -460,6 +461,13 @@ def cmd_serve(args) -> int:
             return restored
         cfg, params = restored
 
+    faults = None
+    if args.chaos_rate > 0:
+        faults = FaultInjector(
+            seed=args.chaos_seed, transient_rate=args.chaos_rate
+        )
+        print(f"chaos mode: transient faults at rate {args.chaos_rate} "
+              f"(seed {args.chaos_seed})")
     engine = ServingEngine(
         cfg, params,
         n_slots=args.slots,
@@ -468,13 +476,18 @@ def cmd_serve(args) -> int:
         top_k=args.top_k if args.top_k > 0 else None,
         scheduler=RequestScheduler(max_queue_depth=args.max_queue),
         rng_seed=args.seed,
+        faults=faults,
     )
-    server = ServingServer(engine, host=args.host, port=args.port)
+    server = ServingServer(
+        engine, host=args.host, port=args.port,
+        request_timeout_s=args.request_timeout,
+        max_restarts=args.max_restarts,
+    )
     host, port = server.address
     print(f"serving on http://{host}:{port}  "
           f"({args.slots} slots, {engine.max_total} tokens/slot, "
-          f"queue depth {args.max_queue})")
-    server.serve_forever()
+          f"queue depth {args.max_queue}, drain {args.drain_s:g}s)")
+    server.serve_forever(drain_s=args.drain_s)
     return 0
 
 
@@ -647,6 +660,22 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("--top-k", type=int, default=40,
                    help="0 disables top-k filtering")
     v.add_argument("--seed", type=int, default=0)
+    v.add_argument("--request-timeout", type=float, default=300.0,
+                   help="seconds a handler waits before answering 504 "
+                   "(the request is cancelled in the engine, freeing "
+                   "its KV slot)")
+    v.add_argument("--drain-s", type=float, default=5.0,
+                   help="graceful-drain window on shutdown: admission "
+                   "stops (503) and in-flight requests get this many "
+                   "seconds to finish")
+    v.add_argument("--max-restarts", type=int, default=5,
+                   help="consecutive engine-crash recoveries before "
+                   "the server declares the engine dead (/healthz 503)")
+    v.add_argument("--chaos-rate", type=float, default=0.0,
+                   help="inject transient faults at engine boundaries "
+                   "at this per-step probability (smoke-tests the "
+                   "supervised retry/replay path; see serving/faults.py)")
+    v.add_argument("--chaos-seed", type=int, default=0)
     v.add_argument(
         "--int8", default="off", choices=["off", "weights", "full"],
         help="weight-only int8 or the fully quantized path (int8 KV "

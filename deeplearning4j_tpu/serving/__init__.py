@@ -14,7 +14,12 @@ Public surface:
 - :class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics` —
   TTFT/TPOT/occupancy/queue-depth with p50/p99 summaries.
 - :class:`~deeplearning4j_tpu.serving.server.ServingServer` — stdlib
-  HTTP-JSON front end.
+  HTTP-JSON front end with graceful drain and health/readiness
+  endpoints.
+- :class:`~deeplearning4j_tpu.serving.faults.FaultInjector` —
+  deterministic (seeded or scripted) fault injection at engine
+  boundaries, driving the supervised step loop / replay recovery
+  (chaos tests: ``tests/test_serving_faults.py``).
 """
 
 from deeplearning4j_tpu.serving.cache_pool import KVSlotPool  # noqa: F401
@@ -22,11 +27,18 @@ from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
     run_request_trace,
 )
+from deeplearning4j_tpu.serving.faults import (  # noqa: F401
+    EngineCrash,
+    FaultInjector,
+    PermanentFault,
+    TransientFault,
+)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Backpressure,
     Request,
     RequestScheduler,
+    RequestStatus,
 )
 from deeplearning4j_tpu.serving.server import ServingServer  # noqa: F401

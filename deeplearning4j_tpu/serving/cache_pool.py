@@ -38,6 +38,8 @@ class KVSlotPool:
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         _, init_caches, _, _ = _decode_builder(cfg)
+        self._init_caches = init_caches
+        self._max_total = max_total
         self.caches = init_caches(n_slots, max_total)
         kv = self.caches["kv"] if isinstance(self.caches, dict) else self.caches
         self.n_slots = n_slots
@@ -71,6 +73,15 @@ class KVSlotPool:
             raise ValueError(f"slot {slot} is not in use")
         self._in_use.remove(slot)
         heapq.heappush(self._free, slot)
+
+    def reinit(self) -> None:
+        """Re-create the pooled cache buffers, zeroed (crash recovery:
+        after an engine-loop crash the old buffers must be assumed
+        corrupt — and with donation they may already be invalidated
+        mid-step). Free-list/occupancy bookkeeping is preserved; the
+        engine re-prefills every live slot afterwards (see
+        ``ServingEngine.recover``)."""
+        self.caches = self._init_caches(self.n_slots, self._max_total)
 
     def nbytes(self) -> int:
         """Device bytes of the pooled cache (all slots)."""

@@ -6,13 +6,15 @@ fixed-shape batch of ``n_slots`` KV-cache slots (one pooled
 ``init_caches`` allocation, see :mod:`cache_pool`); every
 ``step()``:
 
-1. retires slots whose request hit EOS or its ``max_new`` budget
+1. sweeps active slots for cancelled/deadline-expired requests and
+   retires them (slot freed within one step boundary);
+2. retires slots whose request hit EOS or its ``max_new`` budget
    (host-side bookkeeping only — the slot's rows are simply reused);
-2. admits queued requests into freed slots: a per-prompt-length jitted
+3. admits queued requests into freed slots: a per-prompt-length jitted
    prefill runs at batch 1 and its cache rows are inserted into the
    pooled buffers at the slot index (so a long prefill never stalls at
    the batch shape of the decode loop);
-3. runs ONE fused decode step for all slots — sampling each slot's next
+4. runs ONE fused decode step for all slots — sampling each slot's next
    token from its pending logits, then ``forward_one`` with a PER-SLOT
    position vector. Inactive slots decode a dummy token at their stale
    position so the program shape never changes (their rows are fully
@@ -29,6 +31,36 @@ same ``_top_k_filter`` + argmax the plain ``transformer_generate`` path
 uses, and the decode math is row-/padding-invariant (masked cache rows
 contribute exact zeros), so token streams are byte-identical to running
 each request alone — ``tests/test_serving.py`` asserts this.
+
+Fault tolerance (the DL4J lineage: the reference runtime supervised its
+workers via Akka and rebuilt them from ZooKeeper state; here the unit
+of supervision is the engine step and the durable state is host-side).
+The engine consults an optional :class:`~.faults.FaultInjector` at its
+two host boundaries and supervises itself:
+
+- a ``TransientFault`` at a boundary retries with capped exponential
+  backoff (``max_retries``/``retry_backoff_s``/``max_backoff_s``);
+- a fault that PERSISTS past the retry budget, or a ``PermanentFault``,
+  quarantines only the implicated request — slot freed, ``done`` set,
+  status ``FAILED`` — and the batch keeps decoding;
+- an ``EngineCrash`` (or any fault with no implicated request)
+  abandons the device state entirely; :meth:`recover` rebuilds it by
+  DETERMINISTIC REPLAY. Because everything the device holds is a pure
+  function of host state (each live request's prompt + tokens decoded
+  so far), recovery re-prefills every live slot's original prompt and
+  then TEACHER-FORCES the recorded tokens through the same fused
+  ``forward_one`` step in lockstep (per-slot position vector, logits
+  frozen once a slot's recording is exhausted). That re-traces the
+  exact op sequence of the original run, so at ``temperature=0`` the
+  resumed stream is byte-identical to an uninterrupted one — the chaos
+  parity tests in ``tests/test_serving_faults.py`` pin this. (At
+  ``temperature>0`` recovery still loses no request, but the sampling
+  key has advanced, so post-crash tokens are a different valid sample.)
+
+Request lifecycle: ``Request.deadline_s`` and ``Request.cancel()`` are
+checked at admission and at every step boundary; a timed-out or
+cancelled request is retired (status EXPIRED/CANCELLED, partial stream
+in ``results``, KV slot freed) instead of decoding to ``max_new``.
 """
 
 from __future__ import annotations
@@ -46,8 +78,19 @@ from deeplearning4j_tpu.models.transformer import (
     _top_k_filter,
 )
 from deeplearning4j_tpu.serving.cache_pool import KVSlotPool
+from deeplearning4j_tpu.serving.faults import (
+    EngineCrash,
+    FaultInjector,
+    PermanentFault,
+    TransientFault,
+)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
-from deeplearning4j_tpu.serving.scheduler import Request, RequestScheduler
+from deeplearning4j_tpu.serving.scheduler import (
+    Backpressure,
+    Request,
+    RequestScheduler,
+    RequestStatus,
+)
 
 
 class _SlotState:
@@ -68,6 +111,14 @@ class ServingEngine:
     with ``cfg.decode_int8=True`` for the int8 KV cache). Sampling
     settings are engine-wide (they are baked into the compiled step):
     ``temperature=0`` decodes greedily.
+
+    Supervision knobs: ``faults`` (an optional
+    :class:`~.faults.FaultInjector`), ``max_retries`` transient retries
+    per boundary with exponential backoff starting at
+    ``retry_backoff_s`` capped at ``max_backoff_s``. ``results_cap``
+    bounds the finished-stream dict (oldest evicted first) so sustained
+    traffic cannot leak host memory; front ends should prefer
+    :meth:`pop_result`, which removes the entry on read.
     """
 
     def __init__(
@@ -83,6 +134,11 @@ class ServingEngine:
         scheduler: RequestScheduler | None = None,
         metrics: ServingMetrics | None = None,
         rng_seed: int = 0,
+        faults: FaultInjector | None = None,
+        max_retries: int = 3,
+        retry_backoff_s: float = 0.01,
+        max_backoff_s: float = 0.25,
+        results_cap: int = 1024,
     ):
         self.cfg = cfg
         self.n_slots = n_slots
@@ -90,6 +146,11 @@ class ServingEngine:
         self.temperature = temperature
         self.top_k = top_k
         self.approx_top_k = approx_top_k
+        self.faults = faults
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.results_cap = results_cap
 
         fwd1, init_caches, do_prefill, cast_params = _decode_builder(cfg)
         self._fwd1 = fwd1
@@ -117,12 +178,16 @@ class ServingEngine:
         self._results: dict[str, np.ndarray] = {}
         self._key = jax.random.key(rng_seed)
         self._steps = 0
+        self._admitting = 0  # requests between scheduler pop and slot
 
         # donating the cache + logits lets XLA update them in place
         # (the cache is the dominant allocation); CPU jit can't alias
         # donated buffers and would warn every call
         donate = (1, 2) if jax.devices()[0].platform == "tpu" else ()
         self._step_fn = jax.jit(self._build_step(), donate_argnums=donate)
+        self._replay_fn = jax.jit(
+            self._build_replay_step(), donate_argnums=donate
+        )
         self._prefill_fns: dict[int, object] = {}
         self._prefill_donate = donate
 
@@ -149,6 +214,20 @@ class ServingEngine:
             return caches, new_logits, toks
 
         return step
+
+    def _build_replay_step(self):
+        """Teacher-forced decode step for crash recovery: feed RECORDED
+        tokens (no sampling) and freeze the pending-logits rows of
+        slots whose recording is already exhausted — those rows must
+        stay exactly what the slot's last real step produced."""
+        fwd1 = self._fwd1
+
+        def rstep(params, caches, logits, toks, pos, replaying):
+            new_logits, caches = fwd1(params, caches, toks, pos)
+            logits = jnp.where(replaying[:, None], new_logits, logits)
+            return caches, logits
+
+        return rstep
 
     def _prefill_into_slot(self, length: int):
         """Jitted prefill-at-batch-1 + row insert, one program per
@@ -188,55 +267,232 @@ class ServingEngine:
 
     @property
     def results(self) -> dict[str, np.ndarray]:
-        """Finished streams by request id: prompt + generated tokens."""
+        """Terminal streams by request id: prompt + generated tokens
+        (partial for CANCELLED/EXPIRED/FAILED-while-running). Bounded
+        to ``results_cap`` entries, oldest evicted; ``pop_result``
+        consumes an entry."""
         return self._results
+
+    def pop_result(self, req_id: str, default=None):
+        """Remove and return a terminal stream (front-end consumption:
+        read-once keeps the results dict from growing with traffic)."""
+        return self._results.pop(req_id, default)
 
     @property
     def idle(self) -> bool:
-        return not self._active.any() and len(self.scheduler) == 0
+        """True when no request is queued, mid-admission, or decoding.
+        ``pool.n_active`` (not ``_active``) is what covers the admission
+        window — the slot is acquired before the prefill runs and
+        before ``_active`` flips, and a concurrent drain must not
+        mistake that window for idleness; ``_admitting`` covers the few
+        instructions between the scheduler pop and the acquire."""
+        return (self.pool.n_active == 0 and self._admitting == 0
+                and len(self.scheduler) == 0)
 
-    def _admit(self) -> None:
-        while self.pool.n_free and len(self.scheduler):
-            req = self.scheduler.pop()
-            slot = self.pool.acquire()
-            prompt = jnp.asarray(req.prompt[None, :], jnp.int32)
-            fn = self._prefill_into_slot(len(req.prompt))
-            self.pool.caches, self._logits = fn(
-                self.params, self.pool.caches, self._logits, prompt,
-                jnp.int32(slot),
-            )
-            self._pos[slot] = len(req.prompt)
-            self._active[slot] = True
-            self._slots[slot] = _SlotState(req)
+    def cancel(self, req_id: str) -> bool:
+        """Cancel by id: flags the request whether it is queued or
+        decoding; the engine honors the flag within one step. Returns
+        False when the id is unknown (already retired or never seen)."""
+        for st in self._slots:
+            if st is not None and st.req.id == req_id:
+                st.req.cancel()
+                return True
+        return self.scheduler.cancel(req_id)
 
-    def _finish(self, slot: int, now: float) -> None:
+    # -- retirement --------------------------------------------------------
+
+    def _store_result(self, req: Request, tokens: list[int]) -> None:
+        self._results[req.id] = np.concatenate(
+            [req.prompt, np.asarray(tokens, np.int32)]
+        )
+        while len(self._results) > self.results_cap:
+            self._results.pop(next(iter(self._results)))
+
+    def _retire(self, slot: int, status: RequestStatus, now: float,
+                error: str | None = None) -> None:
+        """Free a slot and move its request to a terminal status."""
         st = self._slots[slot]
         req = st.req
-        self._results[req.id] = np.concatenate(
-            [req.prompt, np.asarray(st.tokens, np.int32)]
-        )
-        self.metrics.record_finished(
-            req.id, len(st.tokens),
-            now - (st.t_first_token or now),
-        )
+        req.status = status
+        req.error = error
+        self._store_result(req, st.tokens)
+        if status is RequestStatus.FINISHED:
+            self.metrics.record_finished(
+                req.id, len(st.tokens),
+                now - (st.t_first_token or now),
+            )
+        else:
+            self.metrics.record_outcome(status)
         self.pool.release(slot)
         self._active[slot] = False
         self._slots[slot] = None
         if req.done is not None:
             req.done.set()
 
+    def _retire_unadmitted(self, req: Request, status: RequestStatus,
+                           error: str | None = None) -> None:
+        """Terminal status for a request that never got a slot."""
+        req.status = status
+        req.error = error
+        self.metrics.record_outcome(status)
+        if req.done is not None:
+            req.done.set()
+
+    def _finish(self, slot: int, now: float) -> None:
+        self._retire(slot, RequestStatus.FINISHED, now)
+
+    def _slot_of(self, req_id: str | None) -> int | None:
+        if req_id is None:
+            return None
+        for slot, st in enumerate(self._slots):
+            if st is not None and st.req.id == req_id:
+                return slot
+        return None
+
+    def _sweep_lifecycle(self, now: float) -> None:
+        """Retire cancelled / deadline-expired active slots (this is
+        what bounds slot occupation to one step past cancel/expiry)."""
+        for slot in np.flatnonzero(self._active):
+            req = self._slots[slot].req
+            if req.cancelled:
+                self._retire(int(slot), RequestStatus.CANCELLED, now)
+            elif req.expired(now):
+                self._retire(int(slot), RequestStatus.EXPIRED, now)
+
+    # -- admission ---------------------------------------------------------
+
+    def _prefill_with_retries(self, req: Request, slot: int) -> bool:
+        """Run the admission prefill under transient-retry supervision.
+        Returns False when the request is poisoned (caller fails it)."""
+        prompt = jnp.asarray(req.prompt[None, :], jnp.int32)
+        fn = self._prefill_into_slot(len(req.prompt))
+        attempt, backoff = 0, self.retry_backoff_s
+        while True:
+            try:
+                if self.faults is not None:
+                    self.faults.check("prefill", req_id=req.id)
+                self.pool.caches, self._logits = fn(
+                    self.params, self.pool.caches, self._logits, prompt,
+                    jnp.int32(slot),
+                )
+                return True
+            except TransientFault as e:
+                self.metrics.record_retry()
+                attempt += 1
+                if attempt > self.max_retries:
+                    req.error = (
+                        f"transient prefill fault persisted past "
+                        f"{self.max_retries} retries: {e}"
+                    )
+                    return False
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.max_backoff_s)
+            except PermanentFault as e:
+                req.error = str(e)
+                return False
+
+    def _admit(self, now: float) -> None:
+        while self.pool.n_free and len(self.scheduler):
+            self._admitting += 1
+            try:
+                req = self.scheduler.pop()
+                if req is None:
+                    break
+                if req.cancelled:
+                    self._retire_unadmitted(req, RequestStatus.CANCELLED)
+                    continue
+                if req.expired(now):
+                    self._retire_unadmitted(req, RequestStatus.EXPIRED)
+                    continue
+                slot = self.pool.acquire()
+                try:
+                    ok = self._prefill_with_retries(req, slot)
+                except BaseException:
+                    # EngineCrash (or anything unexpected) between pop
+                    # and admission: the request must not be dropped —
+                    # put it back at the front of its class before the
+                    # supervisor rebuilds state.
+                    self.pool.release(slot)
+                    self.scheduler.requeue(req)
+                    raise
+                if not ok:
+                    self.pool.release(slot)
+                    self._retire_unadmitted(
+                        req, RequestStatus.FAILED, req.error
+                    )
+                    continue
+                self._pos[slot] = len(req.prompt)
+                self._active[slot] = True
+                self._slots[slot] = _SlotState(req)
+                req.status = RequestStatus.RUNNING
+            finally:
+                self._admitting -= 1
+
+    # -- supervised device step --------------------------------------------
+
+    def _step_device(self, sub):
+        """One fused decode step under transient-retry supervision.
+        Persistent faults quarantine the implicated request when one is
+        named, otherwise escalate to ``EngineCrash`` (replay recovery).
+        Returns None when quarantining emptied the batch."""
+        attempt, backoff = 0, self.retry_backoff_s
+        while True:
+            try:
+                if self.faults is not None:
+                    self.faults.check("step")
+                # .copy(): jnp.asarray can zero-copy alias numpy buffers
+                # on CPU and dispatch is async — the host loop mutates
+                # _pos/_active after this call returns
+                return self._step_fn(
+                    self.params, self.pool.caches, self._logits,
+                    jnp.asarray(self._pos.copy()),
+                    jnp.asarray(self._active.copy()), sub,
+                )
+            except TransientFault as e:
+                self.metrics.record_retry()
+                attempt += 1
+                if attempt <= self.max_retries:
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, self.max_backoff_s)
+                    continue
+                slot = self._slot_of(e.req_id)
+                if slot is None:
+                    raise EngineCrash(
+                        f"transient step fault persisted past "
+                        f"{self.max_retries} retries: {e}"
+                    ) from e
+                self._retire(slot, RequestStatus.FAILED, time.perf_counter(),
+                             error=str(e))
+                if not self._active.any():
+                    return None
+                attempt, backoff = 0, self.retry_backoff_s
+            except PermanentFault as e:
+                slot = self._slot_of(e.req_id)
+                if slot is None:
+                    raise EngineCrash(
+                        f"permanent step fault names no live request: {e}"
+                    ) from e
+                self._retire(slot, RequestStatus.FAILED, time.perf_counter(),
+                             error=str(e))
+                if not self._active.any():
+                    return None
+
     def step(self) -> bool:
-        """Admit waiting requests, run one fused decode step, retire
-        finished slots. Returns False when there was nothing to do."""
-        self._admit()
+        """Sweep lifecycle, admit waiting requests, run one fused
+        decode step, retire finished slots. Returns False when there
+        was nothing to do. Raises ``EngineCrash`` when the step loop
+        cannot make progress (callers recover via :meth:`recover`)."""
+        now = time.perf_counter()
+        self._sweep_lifecycle(now)
+        self._admit(now)
         if not self._active.any():
             return False
         n_active = int(self._active.sum())
         self._key, sub = jax.random.split(self._key)
-        caches, logits, toks = self._step_fn(
-            self.params, self.pool.caches, self._logits,
-            jnp.asarray(self._pos), jnp.asarray(self._active), sub,
-        )
+        out = self._step_device(sub)
+        if out is None:  # quarantine emptied the batch
+            return True
+        caches, logits, toks = out
         self.pool.caches, self._logits = caches, logits
         toks_host = np.asarray(toks)  # the one host sync per step
         now = time.perf_counter()
@@ -259,11 +515,83 @@ class ServingEngine:
         )
         return True
 
-    def run(self, max_steps: int | None = None) -> dict[str, np.ndarray]:
-        """Step until every queued/active request finishes."""
+    # -- crash recovery ----------------------------------------------------
+
+    def recover(self) -> int:
+        """Rebuild engine/device state by deterministic replay after an
+        engine-loop crash. The device buffers are abandoned (assumed
+        corrupt) and re-created zeroed; every live slot is re-prefilled
+        with its ORIGINAL prompt (the same compiled program and inputs
+        as its first admission, so the result is byte-identical), then
+        the tokens decoded so far are teacher-forced through the fused
+        step in lockstep with per-slot positions — exactly re-tracing
+        the crashed run's op sequence, so greedy decode resumes
+        byte-identically. Queued requests are untouched. Returns the
+        number of live requests replayed."""
+        self.metrics.record_restart()
+        self.pool.reinit()
+        self._logits = jnp.zeros(
+            (self.n_slots, self.cfg.vocab_size), jnp.float32
+        )
+        live = [(s, st) for s, st in enumerate(self._slots)
+                if st is not None]
+        for slot, st in live:
+            prompt = jnp.asarray(st.req.prompt[None, :], jnp.int32)
+            fn = self._prefill_into_slot(len(st.req.prompt))
+            self.pool.caches, self._logits = fn(
+                self.params, self.pool.caches, self._logits, prompt,
+                jnp.int32(slot),
+            )
+            self._pos[slot] = len(st.req.prompt)
+        for j in range(max((len(st.tokens) for _, st in live), default=0)):
+            toks = np.zeros((self.n_slots,), np.int32)
+            replaying = np.zeros((self.n_slots,), bool)
+            for slot, st in live:
+                if j < len(st.tokens):
+                    toks[slot] = st.tokens[j]
+                    replaying[slot] = True
+            # pos must be snapshotted: jnp.asarray can zero-copy alias
+            # a numpy buffer on CPU and dispatch is async, so mutating
+            # self._pos below would race the in-flight replay step
+            self.pool.caches, self._logits = self._replay_fn(
+                self.params, self.pool.caches, self._logits,
+                jnp.asarray(toks), jnp.asarray(self._pos.copy()),
+                jnp.asarray(replaying),
+            )
+            for slot, st in live:
+                if j < len(st.tokens):
+                    self._pos[slot] += 1
+        return len(live)
+
+    def fail_all(self, error: str) -> None:
+        """Terminal supervision failure: fail every live and queued
+        request (slot freed, ``done`` set) so no caller blocks on an
+        engine that will never step again."""
+        now = time.perf_counter()
+        for slot in np.flatnonzero(self._active):
+            self._retire(int(slot), RequestStatus.FAILED, now, error=error)
+        while True:
+            req = self.scheduler.pop()
+            if req is None:
+                break
+            self._retire_unadmitted(req, RequestStatus.FAILED, error)
+
+    def run(self, max_steps: int | None = None, *,
+            max_restarts: int = 5) -> dict[str, np.ndarray]:
+        """Step until every queued/active request reaches a terminal
+        status, supervising crashes: up to ``max_restarts`` replay
+        recoveries before the crash propagates."""
         steps = 0
+        restarts = 0
         while not self.idle:
-            self.step()
+            try:
+                self.step()
+            except EngineCrash:
+                if restarts >= max_restarts:
+                    raise
+                restarts += 1
+                self.recover()
+                continue
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
@@ -275,6 +603,7 @@ def run_request_trace(
     trace: list[tuple[float, Request]],
     *,
     time_scale: float = 1.0,
+    max_restarts: int = 5,
 ) -> dict[str, np.ndarray]:
     """Replay an arrival trace against a live engine.
 
@@ -282,20 +611,39 @@ def run_request_trace(
     relative to the replay start and scaled by ``time_scale`` (0 floods
     every request instantly — useful for deterministic tests). The
     engine keeps stepping while waiting, exactly as a serving loop
-    would, so admissions interleave with in-flight decodes.
+    would, so admissions interleave with in-flight decodes. A submit
+    rejected with ``Backpressure`` is retried on the next loop
+    iteration (a decode step frees queue space) instead of killing the
+    replay, and engine crashes recover by replay up to
+    ``max_restarts`` times.
     """
+    from collections import deque
+
     order = sorted(range(len(trace)), key=lambda j: trace[j][0])
     t0 = time.perf_counter()
     i = 0
-    while i < len(order) or not engine.idle:
+    pending: deque[Request] = deque()
+    restarts = 0
+    while i < len(order) or pending or not engine.idle:
         now = time.perf_counter() - t0
-        while i < len(order):
-            t_arr, req = trace[order[i]]
-            if t_arr * time_scale > now:
-                break
-            engine.submit(req)
+        while i < len(order) and trace[order[i]][0] * time_scale <= now:
+            pending.append(trace[order[i]][1])
             i += 1
-        if not engine.step() and i < len(order):
+        while pending:
+            try:
+                engine.submit(pending[0])
+            except Backpressure:
+                break  # queue full — a step below frees space, retry then
+            pending.popleft()
+        try:
+            progressed = engine.step()
+        except EngineCrash:
+            if restarts >= max_restarts:
+                raise
+            restarts += 1
+            engine.recover()
+            continue
+        if not progressed and not pending and i < len(order):
             # idle engine, next arrival still in the future
             time.sleep(
                 min(0.001, max(0.0, trace[order[i]][0] * time_scale - now))

@@ -41,6 +41,15 @@ class ServingMetrics:
         self.queue_depth: list[int] = []
         self.n_finished = 0
         self.n_generated = 0
+        # fault-tolerance counters (see serving.faults / engine docs):
+        # retries = transient-fault boundary retries; restarts = engine
+        # rebuilds by replay; failed/cancelled/expired = non-FINISHED
+        # terminal request outcomes
+        self.n_retries = 0
+        self.n_restarts = 0
+        self.n_failed = 0
+        self.n_cancelled = 0
+        self.n_expired = 0
         self._step = 0
 
     def _emit(self, tag: str, value: float, step: int | None = None) -> None:
@@ -73,11 +82,40 @@ class ServingMetrics:
             self.tpot.append(tpot)
             self._emit("tpot_seconds", tpot)
 
+    def record_retry(self) -> None:
+        """One transient-fault retry at an engine boundary."""
+        self.n_retries += 1
+        self._emit("retries_total", self.n_retries)
+
+    def record_restart(self) -> None:
+        """One engine-state rebuild by deterministic replay."""
+        self.n_restarts += 1
+        self._emit("restarts_total", self.n_restarts)
+
+    def record_outcome(self, status) -> None:
+        """Non-FINISHED terminal outcome (status is a
+        ``RequestStatus`` or its string value)."""
+        s = getattr(status, "value", status)
+        if s == "failed":
+            self.n_failed += 1
+            self._emit("failed_total", self.n_failed)
+        elif s == "cancelled":
+            self.n_cancelled += 1
+            self._emit("cancelled_total", self.n_cancelled)
+        elif s == "expired":
+            self.n_expired += 1
+            self._emit("expired_total", self.n_expired)
+
     def summary(self) -> dict:
         """Aggregate view: p50/p99 latencies + mean utilization."""
         out = {
             "n_finished": self.n_finished,
             "n_generated": self.n_generated,
+            "n_retries": self.n_retries,
+            "n_restarts": self.n_restarts,
+            "n_failed": self.n_failed,
+            "n_cancelled": self.n_cancelled,
+            "n_expired": self.n_expired,
             "steps": self._step,
         }
         for name, xs in [("ttft", self.ttft), ("tpot", self.tpot)]:

@@ -21,12 +21,26 @@ thread ``pop``s.
 from __future__ import annotations
 
 import dataclasses
+import enum
 import itertools
 import threading
 import time
 from collections import deque
 
 import numpy as np
+
+
+class RequestStatus(str, enum.Enum):
+    """Request lifecycle. Terminal states set ``done`` and free the KV
+    slot (if one was held); only FINISHED puts a full stream in
+    ``engine.results`` (CANCELLED/EXPIRED store the partial stream)."""
+
+    QUEUED = "queued"        # accepted by the scheduler, waiting for a slot
+    RUNNING = "running"      # admitted; prefilled into a KV slot, decoding
+    FINISHED = "finished"    # hit EOS or max_new; full stream available
+    FAILED = "failed"        # poisoned (permanent/persistent fault)
+    CANCELLED = "cancelled"  # caller invoked Request.cancel()
+    EXPIRED = "expired"      # deadline_s elapsed before completion
 
 
 class Backpressure(RuntimeError):
@@ -51,23 +65,56 @@ class Request:
     ``prompt`` is a 1-D int token array; ``max_new`` bounds generation;
     ``eos_token`` (optional) retires the slot early. ``priority`` 0 is
     most urgent. ``arrival_time`` is stamped by the scheduler at submit
-    (perf_counter domain) and anchors TTFT.
+    (perf_counter domain) and anchors TTFT. ``deadline_s`` (optional)
+    is a wall-clock budget from arrival: the engine checks it at
+    admission and at every step boundary and retires the request as
+    EXPIRED (slot freed) the moment it elapses. ``cancel()`` may be
+    called from any thread; the engine honors it within one step.
     """
 
     prompt: np.ndarray
     max_new: int
     priority: int = 1
     eos_token: int | None = None
+    deadline_s: float | None = None
     id: str = dataclasses.field(default_factory=_next_id)
     arrival_time: float | None = None
+    status: RequestStatus = RequestStatus.QUEUED
+    error: str | None = None
     # set by the HTTP front end: signaled when the engine retires the
     # request, so a blocked handler thread can return the result
     done: threading.Event | None = None
+    _cancel_evt: threading.Event = dataclasses.field(
+        default_factory=threading.Event, init=False, repr=False,
+        compare=False,
+    )
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         if self.max_new < 1:
             raise AdmissionError(f"max_new must be >= 1, got {self.max_new}")
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise AdmissionError(
+                f"deadline_s must be >= 0, got {self.deadline_s}"
+            )
+
+    def cancel(self) -> None:
+        """Request best-effort cancellation (thread-safe, idempotent).
+        The engine frees the KV slot within one step boundary."""
+        self._cancel_evt.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel_evt.is_set()
+
+    def expired(self, now: float | None = None) -> bool:
+        """Deadline elapsed? (``now`` in perf_counter domain; measured
+        from scheduler arrival so queue wait counts, like TTFT.)"""
+        if self.deadline_s is None or self.arrival_time is None:
+            return False
+        if now is None:
+            now = time.perf_counter()
+        return (now - self.arrival_time) > self.deadline_s
 
 
 class RequestScheduler:
@@ -110,8 +157,29 @@ class RequestScheduler:
                     f"queue at max depth ({self.max_queue_depth})"
                 )
             req.arrival_time = time.perf_counter()
+            req.status = RequestStatus.QUEUED
             self._queues[req.priority].append(req)
         return req.id
+
+    def requeue(self, req: Request) -> None:
+        """Put a popped-but-not-admitted request back at the FRONT of
+        its priority class (crash recovery: a request must never be
+        dropped between pop and admission). Bypasses depth/budget
+        checks — the request was already admitted once."""
+        with self._lock:
+            req.status = RequestStatus.QUEUED
+            self._queues[req.priority].appendleft(req)
+
+    def cancel(self, req_id: str) -> bool:
+        """Flag a still-queued request as cancelled (it is discarded at
+        its admission turn). Returns False when the id is not queued."""
+        with self._lock:
+            for q in self._queues:
+                for req in q:
+                    if req.id == req_id:
+                        req.cancel()
+                        return True
+        return False
 
     def pop(self) -> Request | None:
         """Highest-priority, oldest request — or None when idle."""

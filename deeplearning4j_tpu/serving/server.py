@@ -6,14 +6,35 @@ loop. Handler threads block on the request's ``done`` event and return
 the finished stream — a synchronous completion API (no streaming; SSE
 would layer on the same engine callbacks).
 
+The engine thread is SUPERVISED: an exception escaping
+``engine.step()`` (an ``EngineCrash`` from the fault layer, or any
+bug) is caught, recorded as ``last_error``, and the engine state is
+rebuilt by deterministic replay (``engine.recover``). After
+``max_restarts`` CONSECUTIVE failed recoveries the engine is declared
+dead: every in-flight and queued request is failed (so no handler
+blocks forever) and ``/healthz`` flips to 503 — which is how an
+orchestrator is told to replace the process.
+
 Endpoints:
 
 - ``POST /v1/generate`` — body ``{"prompt": [ints] | "text",
-  "max_new": int, "priority"?: int, "eos_token"?: int}``; returns
-  ``{"id", "tokens", "text"?}``. 429 on queue backpressure, 400 on a
-  request that can never fit a slot.
+  "max_new": int, "priority"?: int, "eos_token"?: int,
+  "deadline_s"?: float}``; returns ``{"id", "tokens", "text"?}``.
+  429 on queue backpressure, 400 on a request that can never fit a
+  slot, 503 while draining/stopped, 408 when ``deadline_s`` expired,
+  500 when the request was failed by the fault layer, 504 on handler
+  timeout (the request IS cancelled in the engine — its KV slot frees
+  within one step, it does not keep decoding for a gone client).
 - ``GET /metrics`` — ``ServingMetrics.summary()`` + live engine state.
-- ``GET /healthz`` — liveness.
+- ``GET /healthz`` — liveness: 200 while the engine thread is alive
+  (or recovering), 503 once it is dead; payload carries
+  ``engine_alive``, ``last_error`` and the restart count.
+- ``GET /readyz`` — readiness: 200 only when healthy AND not
+  draining; load balancers should route on this one.
+
+``stop(drain_s)`` drains gracefully: admission stops first (new
+submits get 503), in-flight requests get up to ``drain_s`` seconds to
+finish, then the loop and listener shut down.
 
 Text prompts/completions use the repo's byte-level convention
 (latin-1 per byte) and are only offered when ``vocab_size <= 256``.
@@ -30,6 +51,7 @@ from deeplearning4j_tpu.serving.scheduler import (
     AdmissionError,
     Backpressure,
     Request,
+    RequestStatus,
 )
 from deeplearning4j_tpu.utils.httpjson import (
     QuietHandler,
@@ -37,21 +59,39 @@ from deeplearning4j_tpu.utils.httpjson import (
     send_json,
 )
 
+#: HTTP status for each non-FINISHED terminal request state
+_STATUS_HTTP = {
+    RequestStatus.FAILED: 500,
+    RequestStatus.EXPIRED: 408,
+    RequestStatus.CANCELLED: 499,  # nginx-style: client gone
+}
+
 
 class ServingServer:
     """Engine + HTTP front end; ``start()`` is non-blocking."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0, request_timeout_s: float = 300.0):
+                 port: int = 0, request_timeout_s: float = 300.0,
+                 max_restarts: int = 5):
         self.engine = engine
         self.request_timeout_s = request_timeout_s
+        self.max_restarts = max_restarts
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._engine_dead = threading.Event()
+        self._last_error: str | None = None
         server = self
 
         class Handler(QuietHandler):
             def do_GET(self):
                 if self.path == "/healthz":
-                    send_json(self, 200, {"ok": True})
+                    payload = server._health_payload()
+                    send_json(self, 200 if payload["ok"] else 503, payload)
+                elif self.path == "/readyz":
+                    payload = server._health_payload()
+                    ready = payload["ok"] and not payload["draining"]
+                    payload["ready"] = ready
+                    send_json(self, 200 if ready else 503, payload)
                 elif self.path == "/metrics":
                     send_json(self, 200, server._metrics_payload())
                 else:
@@ -60,6 +100,15 @@ class ServingServer:
             def do_POST(self):
                 if self.path != "/v1/generate":
                     send_json(self, 404, {"error": "not found"})
+                    return
+                if server._draining.is_set() or server._stop.is_set():
+                    send_json(self, 503, {"error": "draining"})
+                    return
+                if server._engine_dead.is_set():
+                    send_json(self, 503, {
+                        "error": "engine dead",
+                        "last_error": server._last_error,
+                    })
                     return
                 body = read_json_body(self)
                 if body is None:
@@ -79,9 +128,21 @@ class ServingServer:
                     send_json(self, 400, {"error": str(e)})
                     return
                 if not req.done.wait(server.request_timeout_s):
+                    # cancel in the engine so the slot stops decoding
+                    # for a client that is about to get a timeout
+                    req.cancel()
                     send_json(self, 504, {"error": "generation timed out"})
                     return
-                toks = server.engine.results[req.id].tolist()
+                if req.status is not RequestStatus.FINISHED:
+                    code = _STATUS_HTTP.get(req.status, 500)
+                    server.engine.pop_result(req.id)  # drop partial stream
+                    send_json(self, code, {
+                        "id": req.id,
+                        "status": req.status.value,
+                        "error": req.error or req.status.value,
+                    })
+                    return
+                toks = server.engine.pop_result(req.id).tolist()
                 out = {"id": req.id, "tokens": toks}
                 if server._byte_vocab():
                     out["text"] = bytes(
@@ -121,8 +182,26 @@ class ServingServer:
             eos_token=(
                 int(body["eos_token"]) if "eos_token" in body else None
             ),
+            deadline_s=(
+                float(body["deadline_s"]) if "deadline_s" in body else None
+            ),
             done=threading.Event(),
         )
+
+    def _health_payload(self) -> dict:
+        alive = (self._engine_thread.is_alive()
+                 and not self._engine_dead.is_set())
+        # before start() the thread hasn't run yet; report configured
+        # state rather than dead
+        if not self._engine_thread.ident and not self._engine_dead.is_set():
+            alive = True
+        return {
+            "ok": alive,
+            "engine_alive": alive,
+            "draining": self._draining.is_set(),
+            "last_error": self._last_error,
+            "restarts": self.engine.metrics.n_restarts,
+        }
 
     def _metrics_payload(self) -> dict:
         eng = self.engine
@@ -131,28 +210,81 @@ class ServingServer:
             n_slots=eng.n_slots,
             slots_active=eng.pool.n_active,
             queue_depth=len(eng.scheduler),
+            draining=self._draining.is_set(),
+            engine_alive=self._engine_thread.is_alive()
+            and not self._engine_dead.is_set(),
+            last_error=self._last_error,
         )
         return out
 
     def _engine_loop(self) -> None:
+        consecutive = 0
         while not self._stop.is_set():
-            if not self.engine.step():
-                # idle: nothing queued, nothing decoding
+            try:
+                progressed = self.engine.step()
+                consecutive = 0
+            except Exception as e:  # EngineCrash or an engine bug
+                self._last_error = f"{type(e).__name__}: {e}"
+                consecutive += 1
+                if consecutive > self.max_restarts:
+                    self._die()
+                    return
+                try:
+                    self.engine.recover()
+                except Exception as e2:  # recovery itself is broken
+                    self._last_error = (
+                        f"recover failed: {type(e2).__name__}: {e2}"
+                    )
+                    self._die()
+                    return
+                continue
+            if not progressed:
+                if self._draining.is_set():
+                    return  # drained: nothing queued, nothing decoding
                 time.sleep(0.002)
+
+    def _die(self) -> None:
+        """Unrecoverable: mark dead and unblock every waiting caller."""
+        self._engine_dead.set()
+        try:
+            self.engine.fail_all(f"engine dead: {self._last_error}")
+        except Exception:
+            pass  # state may be arbitrarily corrupt; handlers time out
 
     def start(self) -> "ServingServer":
         self._engine_thread.start()
         self._http_thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 0.0) -> None:
+        """Shut down; with ``drain_s > 0`` drain first: admission stops
+        immediately (new submits 503) and in-flight/queued work gets up
+        to ``drain_s`` seconds to finish before the loop is stopped."""
+        self._draining.set()
+        if drain_s > 0:
+            deadline = time.monotonic() + drain_s
+            while (time.monotonic() < deadline
+                   and self._engine_thread.is_alive()
+                   and not self._engine_dead.is_set()
+                   and not self.engine.idle):
+                time.sleep(0.005)
         self._stop.set()
+        if self._engine_thread.ident:
+            self._engine_thread.join(timeout=10)
+        # anything that missed the drain window (still queued or
+        # decoding) is failed NOW, so its blocked handler answers
+        # immediately instead of hanging until the request timeout
+        if not self._engine_dead.is_set() and not self.engine.idle:
+            try:
+                self.engine.fail_all("server stopped before completion")
+            except Exception:
+                pass
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._engine_thread.join(timeout=5)
 
-    def serve_forever(self) -> None:
-        """Blocking convenience for the CLI."""
+    def serve_forever(self, drain_s: float = 0.0) -> None:
+        """Blocking convenience for the CLI; Ctrl-C drains for
+        ``drain_s`` seconds before exiting."""
         self.start()
         try:
             while True:
@@ -160,4 +292,4 @@ class ServingServer:
         except KeyboardInterrupt:
             pass
         finally:
-            self.stop()
+            self.stop(drain_s)

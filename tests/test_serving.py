@@ -295,6 +295,10 @@ def test_http_server_roundtrip():
             m = json.loads(r.read())
         assert m["n_finished"] >= 2 and "ttft_p50_s" in m
         with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
-            assert json.loads(r.read()) == {"ok": True}
+            hz = json.loads(r.read())
+        assert hz["ok"] is True and hz["engine_alive"] is True
+        assert hz["last_error"] is None and hz["restarts"] == 0
+        with urllib.request.urlopen(f"{base}/readyz", timeout=10) as r:
+            assert json.loads(r.read())["ready"] is True
     finally:
         srv.stop()
