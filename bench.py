@@ -765,7 +765,15 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
     retries with backoff, and the row reports the throughput next to
     the clean number (``clean_tok_per_sec`` / ``degradation_frac``) —
     the claim under test is that degradation at a fixed fault rate is
-    BOUNDED by retry backoff, not a stall or a crash."""
+    BOUNDED by retry backoff, not a stall or a crash.
+
+    The clean row SWEEPS the fused decode horizon K over {1, 2, 4, 8}
+    (same trace, warmup + timed replay per K) and reports the winning
+    horizon's throughput as the headline number, with the K=1 rate and
+    the speedup alongside — the multi-step pipelining claim, priced on
+    the same run. The faults row stays at K=1 so its boundary-check
+    cadence (and therefore the seeded fault pattern) matches the chaos
+    tests."""
     import jax
     import numpy as np
 
@@ -786,7 +794,7 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
         0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
     ).astype(np.int32)
 
-    def make_engine(rate):
+    def make_engine(rate, horizon=1):
         faults = (
             FaultInjector(seed=1234, transient_rate=rate) if rate else None
         )
@@ -794,6 +802,7 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
             cfg, params, n_slots=n_slots,
             temperature=1.0, top_k=40,
             approx_top_k=not args.exact_top_k,
+            decode_horizon=horizon,
             scheduler=RequestScheduler(max_queue_depth=n_requests),
             faults=faults, retry_backoff_s=0.002, max_backoff_s=0.05,
         )
@@ -805,39 +814,75 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
             for i in range(n_requests)
         ]
 
-    def replay(rate=0.0):
-        engine = make_engine(rate)
+    def timed(engine):
         trace = make_trace()
         t0 = time.perf_counter()
         results = run_request_trace(engine, trace)
         dt = time.perf_counter() - t0
-        assert len(results) == n_requests
+        # results may also hold warmup streams (reused engine): check
+        # this trace's ids specifically
+        assert all(r.id in results for _, r in trace)
         s = engine.metrics.summary()
         return s["n_generated"] / dt, s
 
-    replay()  # warmup: compiles the prefill + step programs
-    tok_per_sec, s = replay(fault_rate)
+    if fault_rate:
+        # warmup: compiles the prefill + step programs
+        run_request_trace(make_engine(0.0), make_trace())
+        tok_per_sec, s = timed(make_engine(fault_rate))
+        clean_tok_per_sec, _ = timed(make_engine(0.0))
+        extra = {
+            "ttft_p50_s": round(s["ttft_p50_s"], 4),
+            "ttft_p99_s": round(s["ttft_p99_s"], 4),
+            "occupancy_mean": round(s["occupancy_mean"], 2),
+            "n_slots": n_slots,
+            "n_requests": n_requests,
+            "fault_rate": fault_rate,
+            "n_retries": s["n_retries"],
+            "n_restarts": s["n_restarts"],
+            "clean_tok_per_sec": round(clean_tok_per_sec, 1),
+            "degradation_frac": round(
+                1.0 - tok_per_sec / clean_tok_per_sec, 4
+            ),
+        }
+        metric = ("transformer_gpt2s_h128_decode_serve_faults_"
+                  "tokens_per_sec_per_chip")
+        return tok_per_sec, metric, extra
+
+    # clean row: sweep the fused horizon, same trace per K. jit caches
+    # are per-engine, so each K warms on ITS timed engine (one full
+    # replay compiles that horizon's step/prefill programs), then the
+    # metrics are reset and the same trace is replayed for the clock.
+    from deeplearning4j_tpu.serving import ServingMetrics
+
+    sweep = {}
+    summaries = {}
+    for k in (1, 2, 4, 8):
+        engine = make_engine(0.0, k)
+        run_request_trace(engine, make_trace())  # warmup/compile
+        engine.metrics = ServingMetrics()
+        engine.metrics.decode_horizon = k
+        tps, s = timed(engine)
+        sweep[k] = tps
+        summaries[k] = s
+    best_k = max(sweep, key=lambda k: sweep[k])
+    tok_per_sec, s = sweep[best_k], summaries[best_k]
     extra = {
         "ttft_p50_s": round(s["ttft_p50_s"], 4),
         "ttft_p99_s": round(s["ttft_p99_s"], 4),
         "occupancy_mean": round(s["occupancy_mean"], 2),
         "n_slots": n_slots,
         "n_requests": n_requests,
+        "decode_horizon": best_k,
+        "horizon_sweep_tok_per_sec": {
+            str(k): round(v, 1) for k, v in sweep.items()
+        },
+        "k1_tok_per_sec": round(sweep[1], 1),
+        "horizon_speedup": round(tok_per_sec / sweep[1], 3),
+        "dispatch_overlap_frac": round(
+            s.get("dispatch_overlap_frac", 0.0), 3
+        ),
     }
     metric = "transformer_gpt2s_h128_decode_serve_tokens_per_sec_per_chip"
-    if fault_rate:
-        clean_tok_per_sec, _ = replay()
-        extra.update(
-            fault_rate=fault_rate,
-            n_retries=s["n_retries"],
-            n_restarts=s["n_restarts"],
-            clean_tok_per_sec=round(clean_tok_per_sec, 1),
-            degradation_frac=round(
-                1.0 - tok_per_sec / clean_tok_per_sec, 4
-            ),
-        )
-        metric = ("transformer_gpt2s_h128_decode_serve_faults_"
-                  "tokens_per_sec_per_chip")
     return tok_per_sec, metric, extra
 
 

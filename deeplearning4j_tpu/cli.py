@@ -474,6 +474,7 @@ def cmd_serve(args) -> int:
         max_total=args.max_total,
         temperature=args.temperature,
         top_k=args.top_k if args.top_k > 0 else None,
+        decode_horizon=args.decode_horizon,
         scheduler=RequestScheduler(max_queue_depth=args.max_queue),
         rng_seed=args.seed,
         faults=faults,
@@ -482,10 +483,12 @@ def cmd_serve(args) -> int:
         engine, host=args.host, port=args.port,
         request_timeout_s=args.request_timeout,
         max_restarts=args.max_restarts,
+        hang_threshold_s=args.hang_threshold,
     )
     host, port = server.address
     print(f"serving on http://{host}:{port}  "
           f"({args.slots} slots, {engine.max_total} tokens/slot, "
+          f"decode horizon {engine.decode_horizon}, "
           f"queue depth {args.max_queue}, drain {args.drain_s:g}s)")
     server.serve_forever(drain_s=args.drain_s)
     return 0
@@ -664,10 +667,24 @@ def main(argv: list[str] | None = None) -> int:
                    help="seconds a handler waits before answering 504 "
                    "(the request is cancelled in the engine, freeing "
                    "its KV slot)")
+    v.add_argument("--decode-horizon", type=int, default=4,
+                   help="decode steps fused into one dispatched device "
+                   "program (K); tokens are read back one horizon "
+                   "behind dispatch, amortizing launch + host-sync "
+                   "overhead at the cost of up-to-K-steps extra "
+                   "admission/first-token latency. 1 = per-step "
+                   "cadence. bench serve sweeps K and reports the "
+                   "winning horizon")
     v.add_argument("--drain-s", type=float, default=5.0,
                    help="graceful-drain window on shutdown: admission "
                    "stops (503) and in-flight requests get this many "
-                   "seconds to finish")
+                   "seconds to finish; stragglers still decoding at "
+                   "the deadline are preempted (cancelled, partial "
+                   "stream returned with HTTP 499)")
+    v.add_argument("--hang-threshold", type=float, default=120.0,
+                   help="seconds without an engine-loop heartbeat "
+                   "(while work is pending) before /healthz reports "
+                   "the engine hung and flips to 503")
     v.add_argument("--max-restarts", type=int, default=5,
                    help="consecutive engine-crash recoveries before "
                    "the server declares the engine dead (/healthz 503)")

@@ -928,13 +928,20 @@ def _decode_builder(cfg: TransformerConfig):
             (nl, 2, batch, tpad, h * kd), cfg.compute_dtype
         )
 
-    def prefill(params, caches, prompt):
+    def prefill(params, caches, prompt, last_idx=None):
         """Bulk prefill: ONE causal forward over the whole prompt fills
         every layer's KV cache and yields the last-position logits —
         the standard inference split (parallel prefill, serial decode).
         Round 1 walked the prompt through ``forward_one`` position by
         position: T_p sequential layer scans; this is a single
         training-shaped pass (T_p-way parallel on the MXU).
+
+        ``last_idx`` (traced int, default ``tp - 1``) selects which row
+        the returned logits come from — callers that right-pad the
+        prompt to a length bucket (the serving engine) pass the true
+        last-token index. Causal masking makes the padded rows
+        invisible to rows <= last_idx, so the logits are bitwise
+        identical to an exact-length prefill.
         """
         b, tp = prompt.shape
         if tp == 0:
@@ -1020,8 +1027,14 @@ def _decode_builder(cfg: TransformerConfig):
             return x, kv
 
         x, kv_all = lax.scan(layer, x, (params["blocks"], kv_all))
+        if last_idx is None:
+            x_last = x[:, -1]
+        else:
+            x_last = lax.dynamic_index_in_dim(
+                x, last_idx, axis=1, keepdims=False
+            )
         x = _layer_norm(
-            x[:, -1], params["lnf_scale"], params["lnf_bias"]
+            x_last, params["lnf_scale"], params["lnf_bias"]
         )
         logits = jnp.einsum(
             "bd,dv->bv", x, _w(params, "head", x.dtype),
@@ -1318,7 +1331,7 @@ def _chunk_builder(cfg: TransformerConfig):
     Per-layer work delegates to :func:`_block_chunk` — the same code
     ``block_decode``'s non-kernel path runs at C=1."""
 
-    def forward_chunk(params, caches, toks, pos0):
+    def forward_chunk(params, caches, toks, pos0, last_idx=None):
         b, c = toks.shape
         # per-index clip: positions past max_len (possible only for
         # slots whose outputs are discarded at the buffer slice) clamp
@@ -1333,6 +1346,20 @@ def _chunk_builder(cfg: TransformerConfig):
         for i in range(cfg.n_layers):
             p_i = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
             x, kv_all = _block_chunk(cfg, x, p_i, kv_all, i, pos0)
+        if last_idx is not None:
+            # single-row logits (bucketed-prefill chunking: only the
+            # true last token's row matters; skips the (C, V) head)
+            x_last = lax.dynamic_index_in_dim(
+                x, last_idx, axis=1, keepdims=False
+            )
+            x_last = _layer_norm(
+                x_last, params["lnf_scale"], params["lnf_bias"]
+            )
+            logits = jnp.einsum(
+                "bd,dv->bv", x_last, _w(params, "head", x_last.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return logits, kv_all
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
         logits = jnp.einsum(
             "bcd,dv->bcv", x, _w(params, "head", x.dtype),

@@ -46,6 +46,11 @@ class KVSlotPool:
         self.tpad = kv.shape[3]  # rounded-up row count per slot
         self._free = list(range(n_slots))  # already a heap
         self._in_use: set[int] = set()
+        # per-slot generation, bumped on acquire: with pipelined
+        # readback a token block can arrive for a slot that was retired
+        # and re-acquired after its dispatch — the generation lets the
+        # engine tell the block belongs to the previous occupant
+        self._gen = [0] * n_slots
 
     @property
     def n_free(self) -> int:
@@ -66,7 +71,13 @@ class KVSlotPool:
             raise RuntimeError("no free KV slots")
         slot = heapq.heappop(self._free)
         self._in_use.add(slot)
+        self._gen[slot] += 1
         return slot
+
+    def generation(self, slot: int) -> int:
+        """Acquire count for ``slot`` — identifies the current occupant
+        across release/re-acquire (see ``_gen`` above)."""
+        return self._gen[slot]
 
     def release(self, slot: int) -> None:
         if slot not in self._in_use:

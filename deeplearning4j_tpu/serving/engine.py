@@ -1,42 +1,69 @@
-"""Continuous-batching decode engine.
+"""Continuous-batching decode engine — pipelined, multi-step hot path.
 
-Iteration-level scheduling (Orca, OSDI '22): instead of batching whole
-requests, the engine batches individual DECODE STEPS. It owns a
-fixed-shape batch of ``n_slots`` KV-cache slots (one pooled
-``init_caches`` allocation, see :mod:`cache_pool`); every
-``step()``:
+Iteration-level scheduling (Orca, OSDI '22) composed with multi-step
+scheduling (vLLM): instead of batching whole requests, the engine
+batches DECODE STEPS — and instead of paying one dispatch + one host
+sync per step, it fuses ``decode_horizon`` (K) steps into ONE jitted
+program and overlaps the host side of horizon n with the device side
+of horizon n+1. It owns a fixed-shape batch of ``n_slots`` KV-cache
+slots (one pooled ``init_caches`` allocation, see :mod:`cache_pool`);
+every ``step()``:
 
-1. sweeps active slots for cancelled/deadline-expired requests and
-   retires them (slot freed within one step boundary);
-2. retires slots whose request hit EOS or its ``max_new`` budget
-   (host-side bookkeeping only — the slot's rows are simply reused);
-3. admits queued requests into freed slots: a per-prompt-length jitted
-   prefill runs at batch 1 and its cache rows are inserted into the
-   pooled buffers at the slot index (so a long prefill never stalls at
-   the batch shape of the decode loop);
-4. runs ONE fused decode step for all slots — sampling each slot's next
-   token from its pending logits, then ``forward_one`` with a PER-SLOT
-   position vector. Inactive slots decode a dummy token at their stale
-   position so the program shape never changes (their rows are fully
-   overwritten by the next admission's prefill insert, which copies a
-   whole Tpad slab).
+1. sweeps occupied slots for cancelled/deadline-expired requests and
+   retires them (slot freed within one horizon boundary);
+2. admits queued requests into freed slots: a per-BUCKET jitted
+   prefill runs at batch 1 (the prompt right-padded to a power-of-two
+   length bucket) and its cache rows are inserted into the pooled
+   buffers at the slot index; prompts longer than the largest bucket
+   are chunked through ``forward_chunk`` at the same bucket sizes, so
+   ``_prefill_fns`` holds O(log max_len) programs no matter how many
+   distinct prompt lengths traffic brings;
+3. DISPATCHES one fused K-substep decode program for all slots and
+   only then
+4. SYNCS the PREVIOUS horizon's (slots, K) token block, doing finish
+   detection / retirement / metrics while the device is already
+   computing the next horizon (async double-buffered readback — the
+   ``np.asarray`` sync is the one blocking host sync per horizon).
 
-jit stability: exactly one compiled step program per engine (plus one
-prefill program per distinct prompt length). All per-slot state that
-the device touches — positions, active mask, pending logits — is
-passed as arrays; scheduling decisions happen on host between steps.
+Everything the per-substep decode logic needs lives ON DEVICE and is
+threaded through the programs — positions, active mask, remaining
+token budget, per-slot EOS id, pending logits — so EOS/max-len
+deactivation happens in-program via the active mask: a slot that
+finishes mid-horizon stops advancing (its position freezes, its
+sampled tokens are masked to 0) without any host round trip. The host
+replays the same stopping rule when the block arrives, so host
+bookkeeping and the device mask can never disagree. Host <-> device
+state only meets at admission (prefill writes the slot's state) and at
+crash recovery (state is rebuilt from host records).
+
+Slot-reuse slack: because horizon n's block is synced AFTER horizon
+n+1 is dispatched, a slot retired at sync time may already appear in
+the in-flight horizon. Each dispatch snapshots (slot, occupant,
+pool generation); a sync discards blocks whose slot has since been
+retired or re-acquired (the dummy tokens a finished slot decodes are
+dead by construction — the next admission's prefill insert rewrites
+the whole Tpad slab).
+
+jit stability: exactly one compiled step program per engine, one
+prefill program per power-of-two bucket, one chunk program per bucket
+on the long-prompt path, plus two tiny state-edit programs.
 
 Greedy determinism: at ``temperature=0`` the engine samples via the
-same ``_top_k_filter`` + argmax the plain ``transformer_generate`` path
-uses, and the decode math is row-/padding-invariant (masked cache rows
-contribute exact zeros), so token streams are byte-identical to running
-each request alone — ``tests/test_serving.py`` asserts this.
+same ``_top_k_filter`` + argmax the plain ``transformer_generate``
+path uses; the decode math is row-/padding-invariant (masked cache
+rows contribute exact zeros), and a right-padded bucket prefill is
+bitwise identical to an exact-length prefill at the true last row
+(causal masking — pinned empirically by the parity tests), so token
+streams are byte-identical to running each request alone for every
+horizon K — ``tests/test_serving.py`` asserts K in {1, 2, 4, 8}.
 
-Fault tolerance (the DL4J lineage: the reference runtime supervised its
-workers via Akka and rebuilt them from ZooKeeper state; here the unit
-of supervision is the engine step and the durable state is host-side).
-The engine consults an optional :class:`~.faults.FaultInjector` at its
-two host boundaries and supervises itself:
+Fault tolerance (the DL4J lineage: the reference runtime supervised
+its workers via Akka and rebuilt them from ZooKeeper state; here the
+unit of supervision is the horizon dispatch and the durable state is
+host-side). The engine consults an optional
+:class:`~.faults.FaultInjector` at its two host boundaries — "step"
+before each horizon dispatch, "prefill" before each admission — and
+supervises itself:
 
 - a ``TransientFault`` at a boundary retries with capped exponential
   backoff (``max_retries``/``retry_backoff_s``/``max_backoff_s``);
@@ -44,23 +71,36 @@ two host boundaries and supervises itself:
   quarantines only the implicated request — slot freed, ``done`` set,
   status ``FAILED`` — and the batch keeps decoding;
 - an ``EngineCrash`` (or any fault with no implicated request)
-  abandons the device state entirely; :meth:`recover` rebuilds it by
-  DETERMINISTIC REPLAY. Because everything the device holds is a pure
-  function of host state (each live request's prompt + tokens decoded
-  so far), recovery re-prefills every live slot's original prompt and
-  then TEACHER-FORCES the recorded tokens through the same fused
-  ``forward_one`` step in lockstep (per-slot position vector, logits
-  frozen once a slot's recording is exhausted). That re-traces the
-  exact op sequence of the original run, so at ``temperature=0`` the
-  resumed stream is byte-identical to an uninterrupted one — the chaos
-  parity tests in ``tests/test_serving_faults.py`` pin this. (At
-  ``temperature>0`` recovery still loses no request, but the sampling
-  key has advanced, so post-crash tokens are a different valid sample.)
+  abandons the device state entirely (including any un-synced
+  horizon: its tokens were never recorded, so replay simply
+  regenerates them); :meth:`recover` rebuilds state by DETERMINISTIC
+  REPLAY. Two replay modes:
+
+  * **stepwise** (the conservative default): re-prefill every live
+    slot's original prompt through the same bucketed program as its
+    admission, then TEACHER-FORCE the recorded tokens one fused step
+    at a time — exactly re-tracing the crashed run's op sequence, so
+    at ``temperature=0`` the resumed stream is byte-identical to an
+    uninterrupted one (chaos parity tests pin this);
+  * **chunked** (O(prompt/bucket + tokens/bucket) device calls per
+    slot instead of O(tokens)): re-prefill ``prompt + tokens_so_far``
+    in one pass through the bucketed/chunked prefill path. The
+    prefill-path logits can differ from the decode-path logits in the
+    last float bit (different XLA schedules), so ``chunked_replay=
+    "auto"`` runs a one-time parity probe at first recovery —
+    full-sequence prefill vs prefill+teacher-forcing on a synthetic
+    sequence — and only enables chunked replay when they agree
+    bitwise; otherwise it falls back to stepwise. ``True``/``False``
+    force a mode (``tests/test_serving_faults.py`` covers both).
 
 Request lifecycle: ``Request.deadline_s`` and ``Request.cancel()`` are
-checked at admission and at every step boundary; a timed-out or
-cancelled request is retired (status EXPIRED/CANCELLED, partial stream
-in ``results``, KV slot freed) instead of decoding to ``max_new``.
+checked at every horizon boundary; a timed-out or cancelled request is
+retired (status EXPIRED/CANCELLED, partial stream in ``results``, KV
+slot freed) instead of decoding to ``max_new``. :meth:`preempt_all`
+cancels every live and queued request — the drain-deadline hook
+``ServingServer.stop`` uses to converge instead of waiting out
+stragglers. ``last_dispatch_t`` is a monotonic heartbeat for the
+server's hung-engine watchdog.
 """
 
 from __future__ import annotations
@@ -74,6 +114,7 @@ from jax import lax
 
 from deeplearning4j_tpu.models.transformer import (
     TransformerConfig,
+    _chunk_builder,
     _decode_builder,
     _top_k_filter,
 )
@@ -92,25 +133,54 @@ from deeplearning4j_tpu.serving.scheduler import (
     RequestStatus,
 )
 
+#: device EOS id for requests without one (never equals a sampled token)
+_NO_EOS = -1
+
 
 class _SlotState:
-    """Host-side record for one active slot."""
+    """Host-side record for one occupied slot."""
 
-    __slots__ = ("req", "tokens", "t_first_token")
+    __slots__ = ("req", "tokens", "t_first_token", "gen")
 
-    def __init__(self, req: Request):
+    def __init__(self, req: Request, gen: int):
         self.req = req
         self.tokens: list[int] = []
         self.t_first_token: float | None = None
+        self.gen = gen  # pool generation at admission (reuse detection)
+
+
+class _Inflight:
+    """One dispatched-but-unsynced horizon: the device future holding
+    the (slots, K) token block plus a snapshot of who occupied each
+    slot at dispatch time."""
+
+    __slots__ = ("toks", "snaps", "t_dispatch")
+
+    def __init__(self, toks, snaps, t_dispatch):
+        self.toks = toks
+        self.snaps = snaps  # [(slot, _SlotState)] occupied at dispatch
+        self.t_dispatch = t_dispatch
 
 
 class ServingEngine:
-    """Fixed-shape continuous-batching decode loop.
+    """Fixed-shape pipelined continuous-batching decode loop.
 
     ``params`` may be float or ``quantize_decode_params`` output (pair
     with ``cfg.decode_int8=True`` for the int8 KV cache). Sampling
-    settings are engine-wide (they are baked into the compiled step):
+    settings are engine-wide (they are baked into the compiled step);
     ``temperature=0`` decodes greedily.
+
+    ``decode_horizon`` (K) is the number of decode steps fused into one
+    dispatched program; lifecycle checks, admission and fault injection
+    happen at horizon boundaries, so K trades up-to-K-steps extra
+    admission/TTFT latency for amortized dispatch + host-sync overhead.
+    K=1 reproduces the unpipelined per-step cadence except that token
+    readback still lags dispatch by one step (the double buffer).
+
+    ``prefill_max_bucket`` caps the power-of-two prompt padding bucket;
+    longer prompts are chunked through the same buckets.
+    ``chunked_replay`` picks the crash-replay mode ("auto" probes for
+    bitwise prefill/decode parity at first recovery; see module doc).
 
     Supervision knobs: ``faults`` (an optional
     :class:`~.faults.FaultInjector`), ``max_retries`` transient retries
@@ -131,6 +201,9 @@ class ServingEngine:
         temperature: float = 0.0,
         top_k: int | None = None,
         approx_top_k: bool = False,
+        decode_horizon: int = 1,
+        prefill_max_bucket: int = 128,
+        chunked_replay: bool | str = "auto",
         scheduler: RequestScheduler | None = None,
         metrics: ServingMetrics | None = None,
         rng_seed: int = 0,
@@ -146,6 +219,8 @@ class ServingEngine:
         self.temperature = temperature
         self.top_k = top_k
         self.approx_top_k = approx_top_k
+        self.decode_horizon = max(1, int(decode_horizon))
+        self.chunked_replay = chunked_replay
         self.faults = faults
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
@@ -156,6 +231,7 @@ class ServingEngine:
         self._fwd1 = fwd1
         self._init_caches = init_caches
         self._do_prefill = do_prefill
+        self._fwd_chunk = _chunk_builder(cfg)
         # one-time weight cast (generate does this inside its jitted
         # program; hoisting it out of the per-step program keeps every
         # step from re-casting — same values, cast is deterministic)
@@ -168,58 +244,108 @@ class ServingEngine:
         if self.scheduler.max_total_tokens is None:
             self.scheduler.max_total_tokens = self.max_total
         self.metrics = metrics or ServingMetrics()
+        self.metrics.decode_horizon = self.decode_horizon
 
-        # pending next-token logits per slot (f32, written by prefill
-        # on admission and by every decode step)
+        # power-of-two prompt buckets: the largest must respect the
+        # positional table (prefill embeds rows 0..bucket-1) and the
+        # pooled slab row count (the insert window must fit Tpad)
+        limit = min(int(prefill_max_bucket), cfg.max_len, self.pool.tpad)
+        mb = 1
+        while mb * 2 <= limit:
+            mb *= 2
+        self._max_bucket = mb
+        self._min_bucket = min(8, mb)
+
+        # per-slot decode state, DEVICE-resident (threaded through the
+        # fused step so pipelined dispatch never reads stale host state)
         self._logits = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
-        self._pos = np.zeros((n_slots,), np.int32)
-        self._active = np.zeros((n_slots,), bool)
+        self._dpos = jnp.zeros((n_slots,), jnp.int32)
+        self._dactive = jnp.zeros((n_slots,), bool)
+        self._dbudget = jnp.zeros((n_slots,), jnp.int32)
+        self._deos = jnp.full((n_slots,), _NO_EOS, jnp.int32)
+
         self._slots: list[_SlotState | None] = [None] * n_slots
+        self._inflight: _Inflight | None = None
         self._results: dict[str, np.ndarray] = {}
         self._key = jax.random.key(rng_seed)
         self._steps = 0
         self._admitting = 0  # requests between scheduler pop and slot
+        self.last_dispatch_t: float | None = None  # watchdog heartbeat
+        self._chunked_ok: bool | None = None  # replay parity probe memo
+        self.last_recover_mode: str | None = None
 
-        # donating the cache + logits lets XLA update them in place
-        # (the cache is the dominant allocation); CPU jit can't alias
-        # donated buffers and would warn every call
-        donate = (1, 2) if jax.devices()[0].platform == "tpu" else ()
-        self._step_fn = jax.jit(self._build_step(), donate_argnums=donate)
+        # donating the cache + per-slot state lets XLA update them in
+        # place (the cache is the dominant allocation); CPU jit can't
+        # alias donated buffers and would warn every call
+        tpu = jax.devices()[0].platform == "tpu"
+        self._state_donate = (1, 2, 3, 4, 5) if tpu else ()
+        self._step_fn = jax.jit(
+            self._build_step(), donate_argnums=self._state_donate
+        )
         self._replay_fn = jax.jit(
-            self._build_replay_step(), donate_argnums=donate
+            self._build_replay_step(),
+            donate_argnums=(1, 2) if tpu else (),
+        )
+        self._deact_fn = jax.jit(
+            lambda active, slot: active.at[slot].set(False),
+            donate_argnums=(0,) if tpu else (),
         )
         self._prefill_fns: dict[int, object] = {}
-        self._prefill_donate = donate
+        self._chunk_fns: dict[int, object] = {}
+        self._insert_fn = None
+        self._admit_donate = (0, 1, 2, 3, 4, 5) if tpu else ()
 
     # -- compiled programs -------------------------------------------------
 
     def _build_step(self):
+        """K fused decode substeps in one program. The carry —
+        caches, pending logits, positions, active mask, remaining
+        budget — lives entirely on device; ``eos`` is per-slot data.
+        The chain is unrolled (not ``lax.scan``) so XLA keeps in-place
+        cache updates; the layer loop inside ``fwd1`` is already
+        unrolled for the same reason."""
         fwd1 = self._fwd1
         temperature, top_k = self.temperature, self.top_k
         approx_top_k = self.approx_top_k
+        horizon = self.decode_horizon
 
-        def step(params, caches, logits, pos, active, key):
-            filt = _top_k_filter(logits, top_k, approx_top_k)
-            if temperature == 0:
-                toks = jnp.argmax(filt, axis=-1).astype(jnp.int32)
-            else:
-                toks = jax.random.categorical(
-                    key, filt / temperature, axis=-1
-                ).astype(jnp.int32)
-            # inactive slots decode token 0 at their stale position —
-            # shape stability; the garbage rows they write are dead
-            # (admission prefill rewrites the whole slot slab)
-            toks = jnp.where(active, toks, 0)
-            new_logits, caches = fwd1(params, caches, toks, pos)
-            return caches, new_logits, toks
+        def step(params, caches, logits, pos, active, budget, eos, key):
+            subkeys = (
+                jax.random.split(key, horizon) if temperature != 0 else None
+            )
+            toks_all = []
+            for k in range(horizon):
+                filt = _top_k_filter(logits, top_k, approx_top_k)
+                if temperature == 0:
+                    toks = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+                else:
+                    toks = jax.random.categorical(
+                        subkeys[k], filt / temperature, axis=-1
+                    ).astype(jnp.int32)
+                # inactive slots decode token 0 at their frozen
+                # position — shape stability; the garbage row they
+                # write stays inside their own slab and is wiped by the
+                # next admission's prefill insert
+                toks = jnp.where(active, toks, 0)
+                new_logits, caches = fwd1(params, caches, toks, pos)
+                # advance only live slots, then deactivate in-program:
+                # a slot that just emitted EOS or spent its budget
+                # stops mutating for the rest of the horizon
+                pos = jnp.where(active, pos + 1, pos)
+                budget = jnp.where(active, budget - 1, budget)
+                active = active & (toks != eos) & (budget > 0)
+                logits = new_logits
+                toks_all.append(toks)
+            return (caches, logits, pos, active, budget,
+                    jnp.stack(toks_all, axis=1))
 
         return step
 
     def _build_replay_step(self):
-        """Teacher-forced decode step for crash recovery: feed RECORDED
-        tokens (no sampling) and freeze the pending-logits rows of
-        slots whose recording is already exhausted — those rows must
-        stay exactly what the slot's last real step produced."""
+        """Teacher-forced decode step for stepwise crash recovery: feed
+        RECORDED tokens (no sampling) and freeze the pending-logits
+        rows of slots whose recording is already exhausted — those rows
+        must stay exactly what the slot's last real step produced."""
         fwd1 = self._fwd1
 
         def rstep(params, caches, logits, toks, pos, replaying):
@@ -229,22 +355,32 @@ class ServingEngine:
 
         return rstep
 
-    def _prefill_into_slot(self, length: int):
-        """Jitted prefill-at-batch-1 + row insert, one program per
-        distinct prompt length."""
-        fn = self._prefill_fns.get(length)
+    def _prefill_fn(self, bucket: int):
+        """Jitted fused admission program for one prompt bucket:
+        prefill-at-batch-1 over the padded prompt, slab insert at the
+        slot index, and the slot's device state (pos/active/budget/eos
+        + pending logits) set in the same dispatch."""
+        fn = self._prefill_fns.get(bucket)
         if fn is None:
             do_prefill = self._do_prefill
             init_caches = self._init_caches
             max_total = self.max_total
 
-            def prefill(params, caches, logits, prompt, slot):
+            def prefill(caches, logits, pos, active, budget, eos,
+                        params, prompt, last_idx, slot, pos0, max_new,
+                        eos_tok):
                 # batch-1 prefill into a scratch single-slot cache of
                 # the SAME Tpad as the pool, then insert the slab at
                 # the slot index. The slab copy includes the zero rows
                 # beyond the prompt — that wipes the previous
                 # occupant's rows, so no stale state survives reuse.
-                tmp, lg = do_prefill(params, init_caches(1, max_total), prompt)
+                # ``last_idx`` points at the true last prompt row; the
+                # padded rows are causally invisible to it, so the
+                # logits are bitwise those of an exact-length prefill.
+                tmp, lg = do_prefill(
+                    params, init_caches(1, max_total), prompt,
+                    last_idx=last_idx,
+                )
                 caches = jax.tree.map(
                     lambda c, t: lax.dynamic_update_slice(
                         c, t, (0, 0, slot, 0, 0)
@@ -252,11 +388,106 @@ class ServingEngine:
                     caches, tmp,
                 )
                 logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
-                return caches, logits
+                pos = pos.at[slot].set(pos0)
+                active = active.at[slot].set(True)
+                budget = budget.at[slot].set(max_new)
+                eos = eos.at[slot].set(eos_tok)
+                return caches, logits, pos, active, budget, eos
 
-            fn = jax.jit(prefill, donate_argnums=self._prefill_donate)
-            self._prefill_fns[length] = fn
+            fn = jax.jit(prefill, donate_argnums=self._admit_donate)
+            self._prefill_fns[bucket] = fn
         return fn
+
+    def _chunk_fn(self, bucket: int):
+        """Jitted chunk-at-offset program for the long-prompt path: one
+        ``forward_chunk`` pass over ``bucket`` rows of a batch-1
+        scratch cache, returning the (1, V) logits at ``last_idx``."""
+        fn = self._chunk_fns.get(bucket)
+        if fn is None:
+            fwd_chunk = self._fwd_chunk
+
+            def chunk(params, tmp, toks, pos0, last_idx):
+                lg, tmp = fwd_chunk(
+                    params, tmp, toks, pos0, last_idx=last_idx
+                )
+                return tmp, lg
+
+            fn = jax.jit(chunk)
+            self._chunk_fns[bucket] = fn
+        return fn
+
+    def _insert(self):
+        """Jitted slab insert + state set (no prefill): lands a scratch
+        cache built by the chunked path — or zeros, for an empty
+        prompt — into the pool at the slot index."""
+        if self._insert_fn is None:
+
+            def insert(caches, logits, pos, active, budget, eos, tmp,
+                       lg, slot, pos0, max_new, eos_tok):
+                caches = jax.tree.map(
+                    lambda c, t: lax.dynamic_update_slice(
+                        c, t, (0, 0, slot, 0, 0)
+                    ),
+                    caches, tmp,
+                )
+                logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
+                pos = pos.at[slot].set(pos0)
+                active = active.at[slot].set(True)
+                budget = budget.at[slot].set(max_new)
+                eos = eos.at[slot].set(eos_tok)
+                return caches, logits, pos, active, budget, eos
+
+            self._insert_fn = jax.jit(
+                insert, donate_argnums=self._admit_donate
+            )
+        return self._insert_fn
+
+    # -- bucketing ---------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        """Smallest power-of-two bucket >= n (caller ensures
+        ``n <= self._max_bucket``)."""
+        b = self._min_bucket
+        while b < n:
+            b *= 2
+        return b
+
+    def _chunk_schedule(self, n: int) -> list[tuple[int, int, int]]:
+        """(offset, real_len, bucket) chunks covering a long prompt's
+        rows 0..n-1 through the power-of-two bucket programs. Every
+        write window [offset, offset+bucket) must fit the pooled Tpad
+        (a clamped ``dynamic_update_slice`` would SHIFT over real
+        rows); when the padded tail would spill, the remainder is
+        decomposed into exact power-of-two pieces plus one minimal
+        padded tail, which always fits (pieces are sublane multiples,
+        Tpad is a sublane multiple)."""
+        tpad = self.pool.tpad
+        sched, t0, rem = [], 0, n
+        while rem > self._max_bucket:
+            sched.append((t0, self._max_bucket, self._max_bucket))
+            t0 += self._max_bucket
+            rem -= self._max_bucket
+        if rem:
+            b = self._bucket_for(rem)
+            if t0 + b <= tpad:
+                sched.append((t0, rem, b))
+            else:
+                while rem:
+                    if rem >= b:
+                        sched.append((t0, b, b))
+                        t0 += b
+                        rem -= b
+                    elif b > self._min_bucket:
+                        b //= 2
+                    else:
+                        sched.append((t0, rem, b))
+                        rem = 0
+        for t0, _, b in sched:  # invariant: no clamped insert, ever
+            if t0 + b > tpad:
+                raise AssertionError(
+                    f"chunk window [{t0}, {t0 + b}) spills Tpad {tpad}"
+                )
+        return sched
 
     # -- host-side loop ----------------------------------------------------
 
@@ -280,24 +511,38 @@ class ServingEngine:
 
     @property
     def idle(self) -> bool:
-        """True when no request is queued, mid-admission, or decoding.
-        ``pool.n_active`` (not ``_active``) is what covers the admission
-        window — the slot is acquired before the prefill runs and
-        before ``_active`` flips, and a concurrent drain must not
-        mistake that window for idleness; ``_admitting`` covers the few
-        instructions between the scheduler pop and the acquire."""
+        """True when no request is queued, mid-admission, decoding, or
+        awaiting readback. ``pool.n_active`` (not the device mask) is
+        what covers the admission window — the slot is acquired before
+        the prefill runs, and a concurrent drain must not mistake that
+        window for idleness; ``_admitting`` covers the few instructions
+        between the scheduler pop and the acquire; ``_inflight`` covers
+        the pipelined horizon whose tokens are still on device."""
         return (self.pool.n_active == 0 and self._admitting == 0
-                and len(self.scheduler) == 0)
+                and len(self.scheduler) == 0 and self._inflight is None)
 
     def cancel(self, req_id: str) -> bool:
         """Cancel by id: flags the request whether it is queued or
-        decoding; the engine honors the flag within one step. Returns
-        False when the id is unknown (already retired or never seen)."""
+        decoding; the engine honors the flag within one horizon.
+        Returns False when the id is unknown (already retired or never
+        seen)."""
         for st in self._slots:
             if st is not None and st.req.id == req_id:
                 st.req.cancel()
                 return True
         return self.scheduler.cancel(req_id)
+
+    def preempt_all(self) -> int:
+        """Cancel every live and queued request (drain-deadline
+        preemption: ``ServingServer.stop`` calls this when ``drain_s``
+        elapses, so shutdown converges within one horizon instead of
+        waiting out stragglers). Returns the number newly cancelled."""
+        n = 0
+        for st in self._slots:
+            if st is not None and not st.req.cancelled:
+                st.req.cancel()
+                n += 1
+        return n + self.scheduler.cancel_all()
 
     # -- retirement --------------------------------------------------------
 
@@ -309,8 +554,12 @@ class ServingEngine:
             self._results.pop(next(iter(self._results)))
 
     def _retire(self, slot: int, status: RequestStatus, now: float,
-                error: str | None = None) -> None:
-        """Free a slot and move its request to a terminal status."""
+                error: str | None = None, *,
+                deactivate: bool = False) -> None:
+        """Free a slot and move its request to a terminal status.
+        ``deactivate`` also clears the slot's DEVICE active bit — needed
+        when the device mask may still be live (cancel/expiry/
+        quarantine); a FINISHED slot already deactivated in-program."""
         st = self._slots[slot]
         req = st.req
         req.status = status
@@ -324,8 +573,9 @@ class ServingEngine:
         else:
             self.metrics.record_outcome(status)
         self.pool.release(slot)
-        self._active[slot] = False
         self._slots[slot] = None
+        if deactivate:
+            self._dactive = self._deact_fn(self._dactive, jnp.int32(slot))
         if req.done is not None:
             req.done.set()
 
@@ -350,30 +600,84 @@ class ServingEngine:
         return None
 
     def _sweep_lifecycle(self, now: float) -> None:
-        """Retire cancelled / deadline-expired active slots (this is
-        what bounds slot occupation to one step past cancel/expiry)."""
-        for slot in np.flatnonzero(self._active):
-            req = self._slots[slot].req
-            if req.cancelled:
-                self._retire(int(slot), RequestStatus.CANCELLED, now)
-            elif req.expired(now):
-                self._retire(int(slot), RequestStatus.EXPIRED, now)
+        """Retire cancelled / deadline-expired occupied slots (this is
+        what bounds slot occupation to one horizon past cancel/expiry).
+        Tokens still in flight for a swept slot are discarded at sync
+        by the snapshot identity check."""
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if st.req.cancelled:
+                self._retire(slot, RequestStatus.CANCELLED, now,
+                             deactivate=True)
+            elif st.req.expired(now):
+                self._retire(slot, RequestStatus.EXPIRED, now,
+                             deactivate=True)
 
     # -- admission ---------------------------------------------------------
 
+    def _prefill_seq_into_slot(self, seq: np.ndarray, slot: int,
+                               budget: int, eos_tok: int) -> None:
+        """Land ``seq`` (prompt, or prompt+replayed tokens) in ``slot``
+        through the bucketed prefill path and set the slot's device
+        state: position len(seq), active, ``budget`` tokens remaining.
+        Dispatches O(1) programs for bucket-sized sequences and
+        O(len/bucket) on the chunked long-prompt path."""
+        n = int(len(seq))
+        state = (self.pool.caches, self._logits, self._dpos,
+                 self._dactive, self._dbudget, self._deos)
+        if n == 0:
+            # empty prompt: decode starts from uniform logits over a
+            # zeroed slab, as the unbucketed prefill did
+            tmp = self._init_caches(1, self.max_total)
+            lg = jnp.zeros((1, self.cfg.vocab_size), jnp.float32)
+            out = self._insert()(
+                *state, tmp, lg, jnp.int32(slot), jnp.int32(0),
+                jnp.int32(budget), jnp.int32(eos_tok),
+            )
+        elif n <= self._max_bucket:
+            b = self._bucket_for(n)
+            pad = np.zeros((1, b), np.int32)
+            pad[0, :n] = seq
+            out = self._prefill_fn(b)(
+                *state, self.params, jnp.asarray(pad), jnp.int32(n - 1),
+                jnp.int32(slot), jnp.int32(n), jnp.int32(budget),
+                jnp.int32(eos_tok),
+            )
+        else:
+            # chunked: walk the prompt through forward_chunk at bucket
+            # sizes over a batch-1 scratch cache, then one slab insert —
+            # a long admission compiles nothing new and never stalls
+            # the decode loop on a monster program
+            tmp = self._init_caches(1, self.max_total)
+            lg = None
+            for t0, ln, b in self._chunk_schedule(n):
+                pad = np.zeros((1, b), np.int32)
+                pad[0, :ln] = seq[t0:t0 + ln]
+                tmp, lg = self._chunk_fn(b)(
+                    self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
+                    jnp.int32(ln - 1),
+                )
+            out = self._insert()(
+                *state, tmp, lg, jnp.int32(slot), jnp.int32(n),
+                jnp.int32(budget), jnp.int32(eos_tok),
+            )
+        (self.pool.caches, self._logits, self._dpos, self._dactive,
+         self._dbudget, self._deos) = out
+
     def _prefill_with_retries(self, req: Request, slot: int) -> bool:
         """Run the admission prefill under transient-retry supervision.
-        Returns False when the request is poisoned (caller fails it)."""
-        prompt = jnp.asarray(req.prompt[None, :], jnp.int32)
-        fn = self._prefill_into_slot(len(req.prompt))
+        Returns False when the request is poisoned (caller fails it).
+        One fault check per ADMISSION (not per chunk), so scripted
+        chaos plans stay request-aligned."""
         attempt, backoff = 0, self.retry_backoff_s
+        eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
         while True:
             try:
                 if self.faults is not None:
                     self.faults.check("prefill", req_id=req.id)
-                self.pool.caches, self._logits = fn(
-                    self.params, self.pool.caches, self._logits, prompt,
-                    jnp.int32(slot),
+                self._prefill_seq_into_slot(
+                    req.prompt, slot, req.max_new, eos_tok
                 )
                 return True
             except TransientFault as e:
@@ -421,33 +725,41 @@ class ServingEngine:
                         req, RequestStatus.FAILED, req.error
                     )
                     continue
-                self._pos[slot] = len(req.prompt)
-                self._active[slot] = True
-                self._slots[slot] = _SlotState(req)
+                self._slots[slot] = _SlotState(
+                    req, self.pool.generation(slot)
+                )
                 req.status = RequestStatus.RUNNING
+                if req.arrival_time is not None:
+                    self.metrics.record_admitted(
+                        req.id, time.perf_counter() - req.arrival_time
+                    )
             finally:
                 self._admitting -= 1
 
-    # -- supervised device step --------------------------------------------
+    # -- supervised dispatch + pipelined readback --------------------------
 
-    def _step_device(self, sub):
-        """One fused decode step under transient-retry supervision.
-        Persistent faults quarantine the implicated request when one is
-        named, otherwise escalate to ``EngineCrash`` (replay recovery).
-        Returns None when quarantining emptied the batch."""
+    def _dispatch(self) -> _Inflight | None:
+        """Dispatch one fused K-substep horizon for every occupied slot
+        under transient-retry supervision; returns the in-flight record
+        WITHOUT syncing its tokens. Persistent faults quarantine the
+        implicated request when one is named, otherwise escalate to
+        ``EngineCrash`` (replay recovery). Returns None when there is
+        nothing to dispatch (or quarantining emptied the batch)."""
+        if not any(st is not None for st in self._slots):
+            return None
         attempt, backoff = 0, self.retry_backoff_s
+        self._key, sub = jax.random.split(self._key)
         while True:
             try:
                 if self.faults is not None:
                     self.faults.check("step")
-                # .copy(): jnp.asarray can zero-copy alias numpy buffers
-                # on CPU and dispatch is async — the host loop mutates
-                # _pos/_active after this call returns
-                return self._step_fn(
+                (self.pool.caches, self._logits, self._dpos,
+                 self._dactive, self._dbudget, toks) = self._step_fn(
                     self.params, self.pool.caches, self._logits,
-                    jnp.asarray(self._pos.copy()),
-                    jnp.asarray(self._active.copy()), sub,
+                    self._dpos, self._dactive, self._dbudget,
+                    self._deos, sub,
                 )
+                break
             except TransientFault as e:
                 self.metrics.record_retry()
                 attempt += 1
@@ -461,9 +773,10 @@ class ServingEngine:
                         f"transient step fault persisted past "
                         f"{self.max_retries} retries: {e}"
                     ) from e
-                self._retire(slot, RequestStatus.FAILED, time.perf_counter(),
-                             error=str(e))
-                if not self._active.any():
+                self._retire(slot, RequestStatus.FAILED,
+                             time.perf_counter(), error=str(e),
+                             deactivate=True)
+                if not any(st is not None for st in self._slots):
                     return None
                 attempt, backoff = 0, self.retry_backoff_s
             except PermanentFault as e:
@@ -472,77 +785,178 @@ class ServingEngine:
                     raise EngineCrash(
                         f"permanent step fault names no live request: {e}"
                     ) from e
-                self._retire(slot, RequestStatus.FAILED, time.perf_counter(),
-                             error=str(e))
-                if not self._active.any():
+                self._retire(slot, RequestStatus.FAILED,
+                             time.perf_counter(), error=str(e),
+                             deactivate=True)
+                if not any(st is not None for st in self._slots):
                     return None
+        now = time.perf_counter()
+        self.last_dispatch_t = now
+        snaps = [(s, st) for s, st in enumerate(self._slots)
+                 if st is not None]
+        self.metrics.record_step(
+            len(snaps), self.n_slots, len(self.scheduler)
+        )
+        return _Inflight(toks, snaps, now)
+
+    def _process(self, horizon: _Inflight) -> None:
+        """Sync a horizon's (slots, K) token block and do the host-side
+        bookkeeping: append tokens (replaying the same EOS/budget
+        stopping rule the device mask applied in-program), stamp first
+        tokens, retire finished slots. Blocks whose slot was retired or
+        re-acquired since dispatch are discarded."""
+        t_sync = time.perf_counter()
+        toks_host = np.asarray(horizon.toks)  # THE host sync, 1/horizon
+        now = time.perf_counter()
+        self.metrics.record_readback(
+            sync_wait_s=now - t_sync,
+            overlap_s=max(0.0, t_sync - horizon.t_dispatch),
+        )
+        for slot, st in horizon.snaps:
+            if (self._slots[slot] is not st
+                    or st.gen != self.pool.generation(slot)):
+                continue  # retired/reused since dispatch: tokens dead
+            req = st.req
+            finished = False
+            for k in range(toks_host.shape[1]):
+                tok = int(toks_host[slot, k])
+                if st.t_first_token is None:
+                    st.t_first_token = now
+                    if req.arrival_time is not None:
+                        self.metrics.record_first_token(
+                            req.id, now - req.arrival_time
+                        )
+                st.tokens.append(tok)
+                if (tok == req.eos_token
+                        or len(st.tokens) >= req.max_new):
+                    finished = True
+                    break  # device mask froze this slot here too
+            if finished:
+                self._finish(slot, now)
 
     def step(self) -> bool:
-        """Sweep lifecycle, admit waiting requests, run one fused
-        decode step, retire finished slots. Returns False when there
-        was nothing to do. Raises ``EngineCrash`` when the step loop
-        cannot make progress (callers recover via :meth:`recover`)."""
+        """One horizon boundary: sweep lifecycle, admit waiting
+        requests, dispatch the next K-substep horizon, then sync and
+        process the PREVIOUS horizon's tokens (so host bookkeeping
+        overlaps device compute). Returns False when there was nothing
+        to do. Raises ``EngineCrash`` when the dispatch loop cannot
+        make progress (callers recover via :meth:`recover`)."""
         now = time.perf_counter()
         self._sweep_lifecycle(now)
         self._admit(now)
-        if not self._active.any():
-            return False
-        n_active = int(self._active.sum())
-        self._key, sub = jax.random.split(self._key)
-        out = self._step_device(sub)
-        if out is None:  # quarantine emptied the batch
-            return True
-        caches, logits, toks = out
-        self.pool.caches, self._logits = caches, logits
-        toks_host = np.asarray(toks)  # the one host sync per step
-        now = time.perf_counter()
-        self._steps += 1
-        for slot in np.flatnonzero(self._active):
-            st = self._slots[slot]
-            tok = int(toks_host[slot])
-            if st.t_first_token is None:
-                st.t_first_token = now
-                self.metrics.record_first_token(
-                    st.req.id, now - st.req.arrival_time
-                )
-            st.tokens.append(tok)
-            self._pos[slot] += 1
-            if (len(st.tokens) >= st.req.max_new
-                    or tok == st.req.eos_token):
-                self._finish(int(slot), now)
-        self.metrics.record_step(
-            n_active, self.n_slots, len(self.scheduler)
-        )
-        return True
+        prev, self._inflight = self._inflight, self._dispatch()
+        if self._inflight is not None:
+            self._steps += 1
+        if prev is not None:
+            self._process(prev)
+        return prev is not None or self._inflight is not None
 
     # -- crash recovery ----------------------------------------------------
+
+    def _reset_device_state(self) -> None:
+        self._logits = jnp.zeros(
+            (self.n_slots, self.cfg.vocab_size), jnp.float32
+        )
+        self._dpos = jnp.zeros((self.n_slots,), jnp.int32)
+        self._dactive = jnp.zeros((self.n_slots,), bool)
+        self._dbudget = jnp.zeros((self.n_slots,), jnp.int32)
+        self._deos = jnp.full((self.n_slots,), _NO_EOS, jnp.int32)
+
+    def _probe_chunked_parity(self) -> bool:
+        """One-time probe for ``chunked_replay="auto"``: does a
+        full-sequence bucketed prefill reproduce, bitwise, the logits
+        of a shorter prefill + teacher-forced decode? (They are
+        differently-scheduled XLA programs; on some backends they agree
+        only to float-reassociation level, in which case chunked replay
+        would break greedy byte-parity and stepwise replay is used.)
+        Runs on abandoned pre-recovery state and leaves state zeroed."""
+        length = int(min(self._max_bucket + 1, self.max_total))
+        k = length - 2
+        if k < 1:
+            return False
+        seq = ((1 + np.arange(length)) % self.cfg.vocab_size).astype(
+            np.int32
+        )
+        self.pool.reinit()
+        self._reset_device_state()
+        self._prefill_seq_into_slot(seq, 0, budget=1, eos_tok=_NO_EOS)
+        la = np.asarray(self._logits[0])
+        self.pool.reinit()
+        self._reset_device_state()
+        self._prefill_seq_into_slot(seq[:k], 0, budget=1, eos_tok=_NO_EOS)
+        pos = np.zeros((self.n_slots,), np.int32)
+        replaying = np.zeros((self.n_slots,), bool)
+        replaying[0] = True
+        for j in range(k, length):
+            toks = np.zeros((self.n_slots,), np.int32)
+            toks[0] = seq[j]
+            pos[0] = j
+            self.pool.caches, self._logits = self._replay_fn(
+                self.params, self.pool.caches, self._logits,
+                jnp.asarray(toks), jnp.asarray(pos.copy()),
+                jnp.asarray(replaying),
+            )
+        lb = np.asarray(self._logits[0])
+        self.pool.reinit()
+        self._reset_device_state()
+        return bool(np.array_equal(la, lb))
+
+    def _use_chunked_replay(self) -> bool:
+        if self.chunked_replay is True:
+            return True
+        if self.chunked_replay is False:
+            return False
+        if self._chunked_ok is None:
+            self._chunked_ok = self._probe_chunked_parity()
+        return self._chunked_ok
 
     def recover(self) -> int:
         """Rebuild engine/device state by deterministic replay after an
         engine-loop crash. The device buffers are abandoned (assumed
-        corrupt) and re-created zeroed; every live slot is re-prefilled
-        with its ORIGINAL prompt (the same compiled program and inputs
-        as its first admission, so the result is byte-identical), then
-        the tokens decoded so far are teacher-forced through the fused
-        step in lockstep with per-slot positions — exactly re-tracing
-        the crashed run's op sequence, so greedy decode resumes
-        byte-identically. Queued requests are untouched. Returns the
-        number of live requests replayed."""
+        corrupt — with donation they may already be invalidated
+        mid-dispatch) and re-created zeroed; any un-synced horizon is
+        dropped (its tokens were never recorded, so the replayed run
+        regenerates them). Each live slot is then rebuilt either by
+        CHUNKED replay — one bucketed prefill pass over
+        ``prompt + tokens_so_far``, O(len/bucket) device calls — or by
+        STEPWISE replay — re-prefill the original prompt, then
+        teacher-force the recorded tokens one fused step at a time —
+        per ``chunked_replay`` (see class docstring; "auto" probes for
+        bitwise parity and falls back to stepwise). Queued requests are
+        untouched. Returns the number of live requests replayed."""
         self.metrics.record_restart()
-        self.pool.reinit()
-        self._logits = jnp.zeros(
-            (self.n_slots, self.cfg.vocab_size), jnp.float32
-        )
+        self._inflight = None
         live = [(s, st) for s, st in enumerate(self._slots)
                 if st is not None]
+        chunked = bool(live) and self._use_chunked_replay()
+        self.pool.reinit()
+        self._reset_device_state()
+        self.last_recover_mode = (
+            None if not live else ("chunked" if chunked else "stepwise")
+        )
+        if not live:
+            return 0
+        if chunked:
+            for slot, st in live:
+                req = st.req
+                seq = np.concatenate(
+                    [req.prompt, np.asarray(st.tokens, np.int32)]
+                )
+                eos_tok = (_NO_EOS if req.eos_token is None
+                           else int(req.eos_token))
+                self._prefill_seq_into_slot(
+                    seq, slot, req.max_new - len(st.tokens), eos_tok
+                )
+            return len(live)
+        pos = np.zeros((self.n_slots,), np.int32)
         for slot, st in live:
-            prompt = jnp.asarray(st.req.prompt[None, :], jnp.int32)
-            fn = self._prefill_into_slot(len(st.req.prompt))
-            self.pool.caches, self._logits = fn(
-                self.params, self.pool.caches, self._logits, prompt,
-                jnp.int32(slot),
+            req = st.req
+            eos_tok = (_NO_EOS if req.eos_token is None
+                       else int(req.eos_token))
+            self._prefill_seq_into_slot(
+                req.prompt, slot, req.max_new, eos_tok
             )
-            self._pos[slot] = len(st.req.prompt)
+            pos[slot] = len(req.prompt)
         for j in range(max((len(st.tokens) for _, st in live), default=0)):
             toks = np.zeros((self.n_slots,), np.int32)
             replaying = np.zeros((self.n_slots,), bool)
@@ -552,24 +966,41 @@ class ServingEngine:
                     replaying[slot] = True
             # pos must be snapshotted: jnp.asarray can zero-copy alias
             # a numpy buffer on CPU and dispatch is async, so mutating
-            # self._pos below would race the in-flight replay step
+            # pos below would race the in-flight replay step
             self.pool.caches, self._logits = self._replay_fn(
                 self.params, self.pool.caches, self._logits,
-                jnp.asarray(toks), jnp.asarray(self._pos.copy()),
+                jnp.asarray(toks), jnp.asarray(pos.copy()),
                 jnp.asarray(replaying),
             )
             for slot, st in live:
                 if j < len(st.tokens):
-                    self._pos[slot] += 1
+                    pos[slot] += 1
+        # stepwise replay drove positions through host arrays; re-seat
+        # the device state to match the rebuilt trajectory
+        active = np.zeros((self.n_slots,), bool)
+        budget = np.zeros((self.n_slots,), np.int32)
+        eos = np.full((self.n_slots,), _NO_EOS, np.int32)
+        for slot, st in live:
+            active[slot] = True
+            budget[slot] = st.req.max_new - len(st.tokens)
+            if st.req.eos_token is not None:
+                eos[slot] = int(st.req.eos_token)
+        self._dpos = jnp.asarray(pos)
+        self._dactive = jnp.asarray(active)
+        self._dbudget = jnp.asarray(budget)
+        self._deos = jnp.asarray(eos)
         return len(live)
 
     def fail_all(self, error: str) -> None:
         """Terminal supervision failure: fail every live and queued
         request (slot freed, ``done`` set) so no caller blocks on an
-        engine that will never step again."""
+        engine that will never step again. Device state is left as-is
+        (possibly corrupt — nothing will dispatch to it again)."""
         now = time.perf_counter()
-        for slot in np.flatnonzero(self._active):
-            self._retire(int(slot), RequestStatus.FAILED, now, error=error)
+        self._inflight = None
+        for slot, st in enumerate(self._slots):
+            if st is not None:
+                self._retire(slot, RequestStatus.FAILED, now, error=error)
         while True:
             req = self.scheduler.pop()
             if req is None:

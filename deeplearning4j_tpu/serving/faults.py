@@ -4,8 +4,11 @@ The original DL4J runtime assumed workers die (Akka supervision trees,
 ZooKeeper-backed state); the serving engine is this repo's equivalent
 heavy-traffic surface, so it gets the equivalent treatment: a
 ``FaultInjector`` the engine consults at its host-side boundaries
-("step" before each fused decode step, "prefill" before each admission
-prefill), raising one of three fault classes the supervisor reacts to:
+("step" before each fused decode DISPATCH — with a multi-step horizon
+that is one K-substep program, so check indices count horizons, not
+tokens — and "prefill" once per admission, however many bucket/chunk
+programs the prompt takes), raising one of three fault classes the
+supervisor reacts to:
 
 - :class:`TransientFault` — recoverable blip (think preempted RPC,
   donated-buffer retry). The engine retries the boundary with capped

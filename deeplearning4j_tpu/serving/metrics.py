@@ -13,7 +13,22 @@ and keeps in-memory series for percentile summaries:
   effective decode batch; > 1 means batching actually interleaved
   requests), with the fraction as ``serve/occupancy_frac``;
 - ``serve/queue_depth`` — queued (not yet admitted) requests, sampled
-  per engine step.
+  per engine step;
+- ``serve/queue_delay_seconds`` — submit-to-admission wait per request
+  (the scheduling component of TTFT, separated out so horizon-induced
+  admission latency is visible on its own);
+- ``serve/sync_wait_seconds`` / ``serve/overlap_seconds`` — per
+  readback, how long the host blocked on the device token sync vs how
+  long it spent doing useful work (bookkeeping + next dispatch) while
+  the horizon computed. ``dispatch_overlap_frac`` in ``summary()`` is
+  overlap / (overlap + sync wait): ~0 means the host serializes with
+  the device (the pre-pipelining behavior), near 1 means readback is
+  fully hidden.
+
+With a multi-step decode horizon (``decode_horizon`` > 1) a "step" in
+the series above is one K-substep horizon dispatch; TTFT is still
+measured to the host-visible first token, so it honestly includes the
+up-to-K-substeps readback lag the pipeline introduces.
 
 p50/p99 come from ``summary()``; with fewer than ~100 samples the p99
 is just the max-ish tail order statistic — fine for a bench row.
@@ -39,6 +54,12 @@ class ServingMetrics:
         self.tpot: list[float] = []
         self.occupancy: list[float] = []
         self.queue_depth: list[int] = []
+        self.queue_delay: list[float] = []
+        self.sync_wait: list[float] = []
+        self.overlap: list[float] = []
+        # stamped by the engine at construction; reported in summary()
+        # so a bench row records which horizon produced its numbers
+        self.decode_horizon = 1
         self.n_finished = 0
         self.n_generated = 0
         # fault-tolerance counters (see serving.faults / engine docs):
@@ -66,6 +87,22 @@ class ServingMetrics:
         self._emit("occupancy_frac", n_active / n_slots, self._step)
         self._emit("queue_depth", queue_depth, self._step)
         self._step += 1
+
+    def record_admitted(self, req_id: str, delay_s: float) -> None:
+        """Request left the queue for a KV slot after ``delay_s``
+        seconds of waiting (admission happens at horizon boundaries, so
+        this is where decode_horizon > 1 shows up first)."""
+        self.queue_delay.append(float(delay_s))
+        self._emit("queue_delay_seconds", delay_s)
+
+    def record_readback(self, sync_wait_s: float,
+                        overlap_s: float) -> None:
+        """One horizon readback: host blocked ``sync_wait_s`` on the
+        token sync after ``overlap_s`` of overlapped host work."""
+        self.sync_wait.append(float(sync_wait_s))
+        self.overlap.append(float(overlap_s))
+        self._emit("sync_wait_seconds", sync_wait_s)
+        self._emit("overlap_seconds", overlap_s)
 
     def record_first_token(self, req_id: str, ttft_s: float) -> None:
         self.ttft.append(float(ttft_s))
@@ -117,11 +154,19 @@ class ServingMetrics:
             "n_cancelled": self.n_cancelled,
             "n_expired": self.n_expired,
             "steps": self._step,
+            "decode_horizon": self.decode_horizon,
         }
-        for name, xs in [("ttft", self.ttft), ("tpot", self.tpot)]:
+        for name, xs in [("ttft", self.ttft), ("tpot", self.tpot),
+                         ("queue_delay", self.queue_delay)]:
             if xs:
                 out[f"{name}_p50_s"] = _pct(xs, 50)
                 out[f"{name}_p99_s"] = _pct(xs, 99)
+        if self.sync_wait:
+            sync = float(np.sum(self.sync_wait))
+            over = float(np.sum(self.overlap))
+            out["sync_wait_mean_s"] = sync / len(self.sync_wait)
+            if sync + over > 0:
+                out["dispatch_overlap_frac"] = over / (sync + over)
         if self.occupancy:
             # mean slots actually decoding per step — the "effective
             # batch" a continuous batcher is supposed to keep > 1

@@ -181,6 +181,20 @@ class RequestScheduler:
                         return True
         return False
 
+    def cancel_all(self) -> int:
+        """Flag every still-queued request as cancelled (drain-deadline
+        preemption: each is discarded at its admission turn, so a
+        stopping engine converges instead of decoding stragglers).
+        Returns the number newly flagged."""
+        n = 0
+        with self._lock:
+            for q in self._queues:
+                for req in q:
+                    if not req.cancelled:
+                        req.cancel()
+                        n += 1
+        return n
+
     def pop(self) -> Request | None:
         """Highest-priority, oldest request — or None when idle."""
         with self._lock:

@@ -27,14 +27,25 @@ Endpoints:
   within one step, it does not keep decoding for a gone client).
 - ``GET /metrics`` — ``ServingMetrics.summary()`` + live engine state.
 - ``GET /healthz`` — liveness: 200 while the engine thread is alive
-  (or recovering), 503 once it is dead; payload carries
-  ``engine_alive``, ``last_error`` and the restart count.
+  (or recovering), 503 once it is dead OR HUNG; payload carries
+  ``engine_alive``, ``last_error``, the restart count, and the
+  watchdog fields. A thread can be alive but wedged — blocked forever
+  inside a device call the fault layer never sees — so the loop
+  maintains a heartbeat (stamped each iteration) and ``/healthz``
+  reports ``hung`` when the engine has non-idle work but the heartbeat
+  is older than ``hang_threshold_s``. An idle engine beats too (the
+  sleep poll), so a quiet server never trips the watchdog.
 - ``GET /readyz`` — readiness: 200 only when healthy AND not
   draining; load balancers should route on this one.
 
 ``stop(drain_s)`` drains gracefully: admission stops first (new
 submits get 503), in-flight requests get up to ``drain_s`` seconds to
-finish, then the loop and listener shut down.
+finish. Stragglers still decoding AT the deadline are PREEMPTED —
+``engine.preempt_all()`` cancels every live and queued request, and
+the loop gets a short grace window to retire them as CANCELLED
+(partial streams stored, ``done`` set, HTTP 499) — before the loop and
+listener shut down. Hard stop (``drain_s=0``) skips the wait and fails
+leftovers instead.
 
 Text prompts/completions use the repo's byte-level convention
 (latin-1 per byte) and are only offered when ``vocab_size <= 256``.
@@ -72,14 +83,19 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, request_timeout_s: float = 300.0,
-                 max_restarts: int = 5):
+                 max_restarts: int = 5, hang_threshold_s: float = 120.0):
         self.engine = engine
         self.request_timeout_s = request_timeout_s
         self.max_restarts = max_restarts
+        self.hang_threshold_s = hang_threshold_s
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._engine_dead = threading.Event()
         self._last_error: str | None = None
+        # watchdog heartbeat: stamped at the top of every engine-loop
+        # iteration, so a loop wedged INSIDE step() (e.g. a device call
+        # that never returns) stops beating while its thread stays alive
+        self._last_beat: float | None = None
         server = self
 
         class Handler(QuietHandler):
@@ -188,6 +204,21 @@ class ServingServer:
             done=threading.Event(),
         )
 
+    def _hung(self, now: float | None = None) -> tuple[bool, float | None]:
+        """(hung?, beat_age_s). Hung = the loop thread is alive but its
+        heartbeat is older than ``hang_threshold_s`` while the engine
+        has work (an idle loop beats every sleep poll, so silence there
+        means wedged, not quiet — but we gate on non-idle anyway to be
+        robust to a paused host clock)."""
+        if self._last_beat is None:
+            return False, None
+        age = (now if now is not None else time.monotonic()) - self._last_beat
+        hung = (age > self.hang_threshold_s
+                and self._engine_thread.is_alive()
+                and not self._stop.is_set()
+                and not self.engine.idle)
+        return hung, age
+
     def _health_payload(self) -> dict:
         alive = (self._engine_thread.is_alive()
                  and not self._engine_dead.is_set())
@@ -195,9 +226,15 @@ class ServingServer:
         # state rather than dead
         if not self._engine_thread.ident and not self._engine_dead.is_set():
             alive = True
+        hung, beat_age = self._hung()
+        if hung:
+            alive = False  # wedged-in-device-call counts as not live
         return {
             "ok": alive,
             "engine_alive": alive,
+            "hung": hung,
+            "beat_age_s": beat_age,
+            "hang_threshold_s": self.hang_threshold_s,
             "draining": self._draining.is_set(),
             "last_error": self._last_error,
             "restarts": self.engine.metrics.n_restarts,
@@ -220,6 +257,7 @@ class ServingServer:
     def _engine_loop(self) -> None:
         consecutive = 0
         while not self._stop.is_set():
+            self._last_beat = time.monotonic()
             try:
                 progressed = self.engine.step()
                 consecutive = 0
@@ -259,7 +297,10 @@ class ServingServer:
     def stop(self, drain_s: float = 0.0) -> None:
         """Shut down; with ``drain_s > 0`` drain first: admission stops
         immediately (new submits 503) and in-flight/queued work gets up
-        to ``drain_s`` seconds to finish before the loop is stopped."""
+        to ``drain_s`` seconds to finish. Requests still running AT the
+        drain deadline are preempted (cancelled through the engine, so
+        each straggler retires as CANCELLED with its partial stream and
+        its handler answers 499) rather than decoded to completion."""
         self._draining.set()
         if drain_s > 0:
             deadline = time.monotonic() + drain_s
@@ -268,6 +309,19 @@ class ServingServer:
                    and not self._engine_dead.is_set()
                    and not self.engine.idle):
                 time.sleep(0.005)
+            if (self._engine_thread.is_alive()
+                    and not self._engine_dead.is_set()
+                    and not self.engine.idle):
+                # deadline hit with stragglers: cancel everything and
+                # give the loop a short bounded grace to retire them
+                # cleanly (one horizon each) before the hard stop below
+                self.engine.preempt_all()
+                grace = time.monotonic() + max(1.0, 0.1 * drain_s)
+                while (time.monotonic() < grace
+                       and self._engine_thread.is_alive()
+                       and not self._engine_dead.is_set()
+                       and not self.engine.idle):
+                    time.sleep(0.005)
         self._stop.set()
         if self._engine_thread.ident:
             self._engine_thread.join(timeout=10)

@@ -54,6 +54,7 @@ def main():
 
     engine = ServingEngine(
         cfg, params, n_slots=4, temperature=0.8, top_k=20,
+        decode_horizon=4,  # 4 fused decode steps per dispatched program
         scheduler=RequestScheduler(max_queue_depth=32),
     )
 

@@ -123,7 +123,10 @@ def test_engine_parity_other_decode_paths(mode):
 def test_slot_admission_and_retirement_ordering():
     """Admission is FIFO within a priority class into the lowest free
     slot; a retired slot is reused by the next queued request; priority
-    0 jumps the FIFO queue."""
+    0 jumps the FIFO queue. Token readback lags dispatch by exactly one
+    horizon (the double buffer), so a request's tokens — and its
+    retirement — land one ``step()`` after the dispatch that computed
+    them; the step counts below pin that cadence."""
     params = _params()
     engine = ServingEngine(CFG, params, n_slots=2, temperature=0.0)
     rng = np.random.default_rng(0)
@@ -137,18 +140,93 @@ def test_slot_admission_and_retirement_ordering():
     a, b, c, d = req(3), req(6), req(3), req(3, priority=0)
     for r in (a, b, c):
         engine.submit(r)
-    engine.step()  # admits a -> slot 0, b -> slot 1; c queued
+    engine.step()  # admits a -> slot 0, b -> slot 1; dispatch #1
     assert engine.pool.n_active == 2
     assert engine._slots[0].req is a and engine._slots[1].req is b
     engine.submit(d)  # priority 0: must admit before c
-    engine.step()
-    engine.step()  # a (max_new=3) retires at step 3
+    engine.step()  # dispatch #2, sync #1 (a: 1 token)
+    engine.step()  # dispatch #3 computes a's last token...
+    assert a.id not in engine.results  # ...but it hasn't synced yet
+    engine.step()  # sync #3: a (max_new=3) completes, slot 0 freed
     assert a.id in engine.results
     engine.step()  # d admitted into a's freed slot 0, ahead of c
     assert engine._slots[0].req is d
     assert engine.pool.n_active == 2
     engine.run()
     assert set(engine.results) == {r.id for r in (a, b, c, d)}
+
+
+@pytest.mark.parametrize("horizon", [2, 4, 8])
+def test_multi_step_horizon_parity(horizon):
+    """The fused K-substep program preserves greedy byte-parity for
+    every horizon (K=1 is the first test): EOS/max-len deactivation
+    happens in-program via the active mask, and the host replays the
+    same stopping rule at sync, so mid-horizon finishes truncate
+    identically."""
+    params = _params()
+    reqs = _requests(8, seed=horizon)
+    refs = _reference_streams(CFG, params, reqs)
+    engine = ServingEngine(
+        CFG, params, n_slots=3, temperature=0.0, decode_horizon=horizon,
+    )
+    trace = [(0.002 * i, r) for i, r in enumerate(reqs)]
+    results = run_request_trace(engine, trace)
+    for rid in refs:
+        np.testing.assert_array_equal(results[rid], refs[rid])
+    s = engine.metrics.summary()
+    assert s["decode_horizon"] == horizon
+    assert s["n_finished"] == len(reqs)
+    # K tokens per dispatch: horizon count is bounded accordingly
+    max_new_total = sum(r.max_new for r in reqs)
+    assert s["steps"] <= -(-max_new_total // horizon) + len(reqs)
+
+
+def test_bucketed_prefill_compile_bound():
+    """Prompts of MANY distinct lengths compile at most one prefill
+    program per power-of-two bucket (the engine pads prompts up to the
+    bucket), each byte-identical to per-request generate — traffic
+    diversity cannot trigger unbounded jit compilation."""
+    params = _params()
+    rng = np.random.default_rng(5)
+    reqs = [
+        Request(prompt=rng.integers(0, 64, (tp,)).astype(np.int32),
+                max_new=4)
+        for tp in range(1, 17)  # every length 1..16
+    ]
+    refs = _reference_streams(CFG, params, reqs)
+    engine = ServingEngine(CFG, params, n_slots=4, temperature=0.0)
+    for r in reqs:
+        engine.submit(r)
+    results = engine.run()
+    for rid in refs:
+        np.testing.assert_array_equal(results[rid], refs[rid])
+    # 16 distinct lengths -> buckets {8, 16} only (min bucket 8)
+    assert set(engine._prefill_fns) <= {8, 16}
+    assert len(engine._prefill_fns) <= 2
+
+
+def test_chunked_long_prompt_prefill_parity():
+    """Prompts longer than the largest bucket stream through the
+    chunked forward path (same bucket programs) and land bitwise with
+    the one-shot prefill trajectory."""
+    params = _params()
+    rng = np.random.default_rng(6)
+    reqs = [
+        Request(prompt=rng.integers(0, 64, (tp,)).astype(np.int32),
+                max_new=6)
+        for tp in (9, 13, 17, 23)  # all > max bucket of 8
+    ]
+    refs = _reference_streams(CFG, params, reqs)
+    engine = ServingEngine(
+        CFG, params, n_slots=2, temperature=0.0, prefill_max_bucket=8,
+    )
+    for r in reqs:
+        engine.submit(r)
+    results = engine.run()
+    for rid in refs:
+        np.testing.assert_array_equal(results[rid], refs[rid])
+    assert engine._max_bucket == 8
+    assert set(engine._chunk_fns) <= {8}
 
 
 def test_eos_retires_slot_early():

@@ -214,6 +214,96 @@ def test_step_fault_naming_request_quarantines_it():
         np.testing.assert_array_equal(clean[a.id], faulted[b.id])
 
 
+# -- chaos at multi-step horizons ----------------------------------------
+
+
+@pytest.mark.parametrize("horizon", [2, 4])
+def test_chaos_parity_at_multi_step_horizon(horizon):
+    """Transient faults AND a full crash with a fused K-substep decode
+    program: the fault boundary is the horizon dispatch, recovery
+    replays the recorded tokens, and streams stay byte-identical to an
+    unfaulted engine — the pipelined hot path keeps the fault-tolerance
+    contract."""
+    reqs = _requests(6, seed=23)
+    clean = _run_clean(reqs)
+
+    reqs2 = _clone(reqs)
+    inj = (FaultInjector()
+           .plan("step", at=1, kind="transient")
+           .plan("step", at=3, kind="crash"))
+    engine = _fast_engine(inj, decode_horizon=horizon)
+    for r in reqs2:
+        engine.submit(r)
+    faulted = engine.run()
+
+    _assert_parity(reqs, clean, reqs2, faulted)
+    assert engine.metrics.n_retries == 1
+    assert engine.metrics.n_restarts == 1
+    assert all(r.status is RequestStatus.FINISHED for r in reqs2)
+
+
+def test_crash_with_unsynced_horizon_drops_no_tokens():
+    """Crash while a dispatched horizon is still awaiting readback: its
+    tokens were never recorded, so replay regenerates them — no
+    duplicates, no gaps. The crash at dispatch #2 lands with dispatch
+    #1's token block still in flight."""
+    reqs = _requests(4, seed=29)
+    clean = _run_clean(reqs)
+
+    reqs2 = _clone(reqs)
+    inj = FaultInjector().plan("step", at=1, kind="crash")
+    engine = _fast_engine(inj, decode_horizon=4)
+    for r in reqs2:
+        engine.submit(r)
+    faulted = engine.run()
+    _assert_parity(reqs, clean, reqs2, faulted)
+    assert engine.metrics.n_restarts == 1
+
+
+def test_chunked_replay_recovery():
+    """Forced chunked replay: recovery re-prefills prompt+tokens in one
+    bucketed pass (O(len/bucket) device calls) instead of stepwise
+    teacher-forcing. On this backend/model the prefill-path caches
+    reproduce the decode trajectory's argmax choices, so the streams
+    still match the clean run (the general guarantee is completion;
+    byte-parity under forced chunked replay is what the "auto" probe
+    exists to verify before relying on it)."""
+    reqs = _requests(4, seed=31)
+    clean = _run_clean(reqs)
+
+    reqs2 = _clone(reqs)
+    inj = FaultInjector().plan("step", at=2, kind="crash")
+    engine = _fast_engine(inj, chunked_replay=True)
+    for r in reqs2:
+        engine.submit(r)
+    faulted = engine.run()
+
+    assert engine.last_recover_mode == "chunked"
+    assert engine.metrics.n_restarts == 1
+    assert all(r.status is RequestStatus.FINISHED for r in reqs2)
+    _assert_parity(reqs, clean, reqs2, faulted)
+
+
+def test_auto_replay_probes_and_preserves_parity():
+    """Default ("auto") replay runs the one-time bitwise parity probe
+    at first recovery and picks a mode; whichever it picks, the
+    recovered streams are byte-identical to a clean run (stepwise by
+    construction; chunked only when the probe proved it)."""
+    reqs = _requests(5, seed=37)
+    clean = _run_clean(reqs)
+
+    reqs2 = _clone(reqs)
+    inj = FaultInjector().plan("step", at=3, kind="crash")
+    engine = _fast_engine(inj)  # chunked_replay defaults to "auto"
+    for r in reqs2:
+        engine.submit(r)
+    faulted = engine.run()
+
+    assert engine._chunked_ok is not None  # probe actually ran
+    assert engine.last_recover_mode in ("stepwise", "chunked")
+    _assert_parity(reqs, clean, reqs2, faulted)
+
+
 # -- lifecycle: cancel and deadlines -------------------------------------
 
 
@@ -423,6 +513,88 @@ def test_server_deadline_maps_to_408():
             {"prompt": [1, 5, 9], "max_new": 25, "deadline_s": 0.2},
         )
         assert status == 408 and body["status"] == "expired"
+    finally:
+        srv.stop()
+
+
+def test_drain_deadline_preempts_stragglers():
+    """stop(drain_s) with a request that cannot finish inside the
+    window: at the deadline the server preempts (cancels) it instead of
+    waiting it out — the handler answers 499/cancelled with the partial
+    stream dropped, and shutdown converges promptly."""
+    engine = _warm_engine(
+        faults=FaultInjector(delay_s=0.05)  # ~50ms/step: 25 tokens >> drain
+    )
+    srv = ServingServer(engine, port=0).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    out = {}
+    try:
+        def worker():
+            out["resp"] = _post(base, {"prompt": [1, 5, 9], "max_new": 25})
+
+        t = threading.Thread(target=worker)
+        t.start()
+        deadline = time.time() + 10
+        while engine.pool.n_active == 0 and time.time() < deadline:
+            time.sleep(0.005)
+        assert engine.pool.n_active == 1
+
+        t0 = time.time()
+        srv.stop(drain_s=0.3)
+        # bounded shutdown: drain window + preemption grace, not the
+        # ~1.5s the straggler would have needed
+        assert time.time() - t0 < 5.0
+        t.join(timeout=30)
+        status, body = out["resp"]
+        assert status == 499 and body["status"] == "cancelled"
+        assert engine.metrics.n_cancelled >= 1
+    finally:
+        srv.stop()
+
+
+def test_watchdog_flags_hung_engine():
+    """An engine wedged inside a step (here: a scripted 0.5s stall per
+    boundary) stops heartbeating while its thread stays alive; once the
+    beat age passes hang_threshold_s with work pending, /healthz
+    reports hung and flips 503 — and recovers to 200 when the engine
+    comes back."""
+    engine = _warm_engine(faults=FaultInjector(delay_s=0.5))
+    srv = ServingServer(engine, port=0, hang_threshold_s=0.1).start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    try:
+        status, body = _get(base, "/healthz")
+        assert status == 200 and body["hung"] is False
+
+        out = {}
+
+        def worker():
+            out["resp"] = _post(base, {"prompt": [1, 5, 9], "max_new": 4})
+
+        t = threading.Thread(target=worker)
+        t.start()
+        saw_hung = False
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            status, body = _get(base, "/healthz")
+            if status == 503 and body["hung"]:
+                saw_hung = True
+                assert body["ok"] is False
+                assert body["beat_age_s"] > srv.hang_threshold_s
+                break
+            time.sleep(0.01)
+        assert saw_hung, "watchdog never flagged the stalled engine"
+        t.join(timeout=30)
+        assert out["resp"][0] == 200  # the stall was latency, not death
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            status, body = _get(base, "/healthz")
+            if status == 200:
+                break
+            time.sleep(0.05)
+        assert status == 200 and body["hung"] is False  # beat resumed
     finally:
         srv.stop()
 
