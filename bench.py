@@ -843,6 +843,8 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
             "degradation_frac": round(
                 1.0 - tok_per_sec / clean_tok_per_sec, 4
             ),
+            "phase_frac": s.get("phase_frac", {}),
+            "phase_seconds": s.get("phase_seconds", {}),
         }
         metric = ("transformer_gpt2s_h128_decode_serve_faults_"
                   "tokens_per_sec_per_chip")
@@ -881,6 +883,8 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
         "dispatch_overlap_frac": round(
             s.get("dispatch_overlap_frac", 0.0), 3
         ),
+        "phase_frac": s.get("phase_frac", {}),
+        "phase_seconds": s.get("phase_seconds", {}),
     }
     metric = "transformer_gpt2s_h128_decode_serve_tokens_per_sec_per_chip"
     return tok_per_sec, metric, extra

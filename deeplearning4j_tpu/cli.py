@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -433,16 +434,27 @@ def cmd_serve(args) -> int:
     testing the serving stack without a checkpoint).
 
     POST /v1/generate {"prompt": "...", "max_new": N} against the
-    printed address; GET /metrics for TTFT/TPOT/occupancy summaries.
-    See the README "Serving" section for the engine architecture."""
+    printed address; GET /metrics for Prometheus text, /metrics.json
+    for the summary view. Observability flags: --trace-out (Perfetto
+    trace on shutdown), --log-json (structured logs), --metrics-port
+    (scrape sidecar), --profile-steps / POST /profile?s=N (XLA
+    captures). See the README "Serving"/"Observability" sections."""
     import jax
 
+    from deeplearning4j_tpu.obs import (
+        ProfileTrigger,
+        Tracer,
+        configure_json_logging,
+    )
     from deeplearning4j_tpu.serving import (
         FaultInjector,
         RequestScheduler,
         ServingEngine,
         ServingServer,
     )
+
+    if args.log_json:
+        configure_json_logging()
 
     if args.demo:
         from deeplearning4j_tpu.models.transformer import init_transformer
@@ -468,6 +480,14 @@ def cmd_serve(args) -> int:
         )
         print(f"chaos mode: transient faults at rate {args.chaos_rate} "
               f"(seed {args.chaos_seed})")
+    tracer = Tracer(
+        enabled=args.trace_out is not None,
+        capacity=args.trace_capacity,
+    )
+    profile = ProfileTrigger(log_dir=args.profile_dir)
+    if args.profile_steps > 0:
+        d = profile.arm(args.profile_steps)
+        print(f"profiling first {args.profile_steps} steps -> {d}")
     engine = ServingEngine(
         cfg, params,
         n_slots=args.slots,
@@ -478,20 +498,64 @@ def cmd_serve(args) -> int:
         scheduler=RequestScheduler(max_queue_depth=args.max_queue),
         rng_seed=args.seed,
         faults=faults,
+        tracer=tracer,
+        profile=profile,
     )
     server = ServingServer(
         engine, host=args.host, port=args.port,
         request_timeout_s=args.request_timeout,
         max_restarts=args.max_restarts,
         hang_threshold_s=args.hang_threshold,
+        metrics_port=args.metrics_port,
     )
     host, port = server.address
     print(f"serving on http://{host}:{port}  "
           f"({args.slots} slots, {engine.max_total} tokens/slot, "
           f"decode horizon {engine.decode_horizon}, "
           f"queue depth {args.max_queue}, drain {args.drain_s:g}s)")
-    server.serve_forever(drain_s=args.drain_s)
+    if server.metrics_address is not None:
+        mh, mp = server.metrics_address
+        print(f"metrics sidecar on http://{mh}:{mp}/metrics")
+    try:
+        if args.run_seconds is not None:
+            # timed run (smoke tests / captures): start, optionally
+            # publish the bound ports, serve for N seconds, drain
+            server.start()
+            if args.port_file:
+                _write_port_file(args.port_file, server)
+            time.sleep(args.run_seconds)
+            server.stop(drain_s=args.drain_s)
+        else:
+            if args.port_file:
+                server.start()
+                _write_port_file(args.port_file, server)
+                try:
+                    while True:
+                        time.sleep(1)
+                except KeyboardInterrupt:
+                    pass
+                finally:
+                    server.stop(args.drain_s)
+            else:
+                server.serve_forever(drain_s=args.drain_s)
+    finally:
+        if args.trace_out:
+            out = tracer.export(args.trace_out)
+            print(f"trace: {tracer.n_events} events "
+                  f"({tracer.dropped} dropped) -> {out}")
     return 0
+
+
+def _write_port_file(path: str, server) -> None:
+    """Publish bound addresses for harnesses that passed --port 0."""
+    host, port = server.address
+    payload = {"host": host, "port": port}
+    if server.metrics_address is not None:
+        payload["metrics_port"] = server.metrics_address[1]
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
 
 
 def cmd_bench(args) -> int:
@@ -693,6 +757,36 @@ def main(argv: list[str] | None = None) -> int:
                    "at this per-step probability (smoke-tests the "
                    "supervised retry/replay path; see serving/faults.py)")
     v.add_argument("--chaos-seed", type=int, default=0)
+    v.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="enable the request-lifecycle tracer and write "
+                   "a Chrome-trace/Perfetto JSON of the ring-buffered "
+                   "spans to PATH on shutdown (open at "
+                   "https://ui.perfetto.dev)")
+    v.add_argument("--trace-capacity", type=int, default=1 << 16,
+                   help="tracer ring-buffer size in events (oldest "
+                   "overwritten beyond this)")
+    v.add_argument("--log-json", action="store_true",
+                   help="structured JSON logs (one object per line on "
+                   "stderr) with req_id correlation across scheduler/"
+                   "engine/server events")
+    v.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus /metrics (+ /healthz) on a "
+                   "dedicated sidecar port, isolated from generate "
+                   "traffic on the main port")
+    v.add_argument("--profile-dir", default="/tmp/dl4j_tpu_profile",
+                   help="directory XLA profiler captures land in "
+                   "(armed via POST /profile?s=N or --profile-steps)")
+    v.add_argument("--profile-steps", type=int, default=0,
+                   help="arm an XLA profiler capture of the FIRST N "
+                   "engine steps at startup (0 = only on-demand via "
+                   "POST /profile)")
+    v.add_argument("--run-seconds", type=float, default=None,
+                   help="run for N seconds then drain and exit "
+                   "(smoke tests / timed captures; default: serve "
+                   "until Ctrl-C)")
+    v.add_argument("--port-file", default=None, metavar="PATH",
+                   help="write the bound addresses as JSON to PATH "
+                   "once listening (for harnesses using --port 0)")
     v.add_argument(
         "--int8", default="off", choices=["off", "weights", "full"],
         help="weight-only int8 or the fully quantized path (int8 KV "

@@ -36,11 +36,15 @@ from deeplearning4j_tpu import rng as rng_mod
 from deeplearning4j_tpu.datasets.base import DataSet
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn.conf import MultiLayerConfig, OptimizationAlgorithm
+from deeplearning4j_tpu.obs.trace import Tracer
 from deeplearning4j_tpu.optimize import Solver
 from deeplearning4j_tpu.optimize.api import IterationListener, ModelFunctions
 from deeplearning4j_tpu.utils import tree_math as tm
 
 log = logging.getLogger(__name__)
+
+#: tracer track for the training orchestrator's phase spans
+TRAIN_TRACK = "train"
 
 Params = list[dict[str, jax.Array]]
 
@@ -63,13 +67,17 @@ def _adapt_input(x: jax.Array, layer_type: str, channels: int) -> jax.Array:
 
 
 class MultiLayerNetwork:
-    def __init__(self, conf: MultiLayerConfig, params: Params | None = None, seed: int = 123):
+    def __init__(self, conf: MultiLayerConfig, params: Params | None = None, seed: int = 123,
+                 tracer: Tracer | None = None):
         self.conf = conf
         self.modules = [L.get(c.layer_type) for c in conf.confs]
         self.keys = rng_mod.KeyStream(seed)
         self.params: Params | None = params
         self.listeners: list[IterationListener] = []
         self._jit_cache: dict = {}
+        # disabled-by-default tracer: fit/pretrain/finetune record
+        # phase spans on the "train" track when one is wired in
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
 
     # -- construction ------------------------------------------------------
     def init(self, key: jax.Array | None = None) -> Params:
@@ -175,22 +183,26 @@ class MultiLayerNetwork:
                 continue
             log.info("pretraining layer %d (%s)", i, c.layer_type)
             iterator.reset()
-            for batch in iterator:
-                x = jnp.asarray(batch.features)
-                layer_input = self.activation_upto(params, x, i)
+            with self.tracer.region(TRAIN_TRACK, "pretrain_layer",
+                                    layer=i, type=c.layer_type):
+                for n_batch, batch in enumerate(iterator):
+                    with self.tracer.region(TRAIN_TRACK, "pretrain_batch",
+                                            layer=i, batch=n_batch):
+                        x = jnp.asarray(batch.features)
+                        layer_input = self.activation_upto(params, x, i)
 
-                if hasattr(mod, "gradient") and c.layer_type == "rbm":
-                    # CD-k statistics are not autodiff of a scalar: drive a
-                    # plain (adagrad-adjusted) iterated update instead of the
-                    # line-search solvers, inside one jitted while_loop.
-                    params[i] = self._pretrain_cdk(mod, c, params[i], layer_input)
-                else:
-                    model = ModelFunctions(
-                        score_and_grad=lambda p, k, mod=mod, c=c, xi=layer_input: mod.gradient(p, c, xi, k),
-                        score=lambda p, k, mod=mod, c=c, xi=layer_input: mod.score(p, c, xi, k),
-                    )
-                    solver = Solver(c, model, listeners=self.listeners)
-                    params[i], _ = solver.optimize(params[i], self.keys.next())
+                        if hasattr(mod, "gradient") and c.layer_type == "rbm":
+                            # CD-k statistics are not autodiff of a scalar: drive a
+                            # plain (adagrad-adjusted) iterated update instead of the
+                            # line-search solvers, inside one jitted while_loop.
+                            params[i] = self._pretrain_cdk(mod, c, params[i], layer_input)
+                        else:
+                            model = ModelFunctions(
+                                score_and_grad=lambda p, k, mod=mod, c=c, xi=layer_input: mod.gradient(p, c, xi, k),
+                                score=lambda p, k, mod=mod, c=c, xi=layer_input: mod.score(p, c, xi, k),
+                            )
+                            solver = Solver(c, model, listeners=self.listeners)
+                            params[i], _ = solver.optimize(params[i], self.keys.next())
 
     def _pretrain_cdk(self, mod, c, layer_params, x):
         """Jitted CD-k update loop for one batch (≙ the RBM fit path)."""
@@ -227,26 +239,30 @@ class MultiLayerNetwork:
             or out_conf.optimization_algo == OptimizationAlgorithm.HESSIAN_FREE
         )
         iterator.reset()
-        for batch in iterator:
-            x = jnp.asarray(batch.features)
-            y = jnp.asarray(batch.labels)
-            if full_backprop:
-                model = self._full_model_fns(x, y)
-                solver = Solver(out_conf, model, listeners=self.listeners)
-                new_params, _ = solver.optimize(params, self.keys.next())
-                for i in range(len(params)):
-                    params[i] = new_params[i]
-            else:
-                h = self.activation_upto(params, x, len(self.modules) - 1)
-                mod = self.modules[-1]
-                model = ModelFunctions(
-                    score_and_grad=lambda p, k, h=h, y=y: jax.value_and_grad(
-                        lambda q: mod.supervised_score(q, out_conf, h, y, k, training=True)
-                    )(p),
-                    score=lambda p, k, h=h, y=y: mod.supervised_score(p, out_conf, h, y, k),
-                )
-                solver = Solver(out_conf, model, listeners=self.listeners)
-                params[-1], _ = solver.optimize(params[-1], self.keys.next())
+        for n_batch, batch in enumerate(iterator):
+            with self.tracer.region(
+                TRAIN_TRACK, "finetune_batch", batch=n_batch,
+                full_backprop=full_backprop,
+            ):
+                x = jnp.asarray(batch.features)
+                y = jnp.asarray(batch.labels)
+                if full_backprop:
+                    model = self._full_model_fns(x, y)
+                    solver = Solver(out_conf, model, listeners=self.listeners)
+                    new_params, _ = solver.optimize(params, self.keys.next())
+                    for i in range(len(params)):
+                        params[i] = new_params[i]
+                else:
+                    h = self.activation_upto(params, x, len(self.modules) - 1)
+                    mod = self.modules[-1]
+                    model = ModelFunctions(
+                        score_and_grad=lambda p, k, h=h, y=y: jax.value_and_grad(
+                            lambda q: mod.supervised_score(q, out_conf, h, y, k, training=True)
+                        )(p),
+                        score=lambda p, k, h=h, y=y: mod.supervised_score(p, out_conf, h, y, k),
+                    )
+                    solver = Solver(out_conf, model, listeners=self.listeners)
+                    params[-1], _ = solver.optimize(params[-1], self.keys.next())
 
     def _full_model_fns(self, x, y) -> ModelFunctions:
         """Whole-network ModelFunctions incl. forward/loss split for HF."""
@@ -275,10 +291,13 @@ class MultiLayerNetwork:
 
     def fit(self, iterator) -> None:
         """≙ fit(DataSetIterator):999 — pretrain (if configured) then finetune."""
-        if self.conf.pretrain:
-            self.pretrain(iterator)
-        iterator.reset()
-        self.finetune(iterator)
+        with self.tracer.region(TRAIN_TRACK, "fit"):
+            if self.conf.pretrain:
+                with self.tracer.region(TRAIN_TRACK, "pretrain"):
+                    self.pretrain(iterator)
+            iterator.reset()
+            with self.tracer.region(TRAIN_TRACK, "finetune"):
+                self.finetune(iterator)
 
     def fit_dataset(self, dataset: DataSet, batch_size: int | None = None) -> None:
         from deeplearning4j_tpu.datasets import ListDataSetIterator

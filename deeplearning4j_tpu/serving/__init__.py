@@ -12,10 +12,13 @@ Public surface:
 - :class:`~deeplearning4j_tpu.serving.engine.ServingEngine` — the
   continuous-batching decode loop (admit / fused step / retire).
 - :class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics` —
-  TTFT/TPOT/occupancy/queue-depth with p50/p99 summaries.
+  TTFT/TPOT/occupancy/queue-depth with p50/p99 summaries, bounded
+  reservoirs, per-phase breakdown, and a Prometheus registry behind
+  ``GET /metrics`` (see :mod:`deeplearning4j_tpu.obs`).
 - :class:`~deeplearning4j_tpu.serving.server.ServingServer` — stdlib
-  HTTP-JSON front end with graceful drain and health/readiness
-  endpoints.
+  HTTP-JSON front end with graceful drain, health/readiness endpoints,
+  Prometheus ``/metrics`` (+ optional sidecar port), and on-demand XLA
+  profiling (``POST /profile``).
 - :class:`~deeplearning4j_tpu.serving.faults.FaultInjector` —
   deterministic (seeded or scripted) fault injection at engine
   boundaries, driving the supervised step loop / replay recovery

@@ -57,6 +57,17 @@ bitwise identical to an exact-length prefill at the true last row
 streams are byte-identical to running each request alone for every
 horizon K — ``tests/test_serving.py`` asserts K in {1, 2, 4, 8}.
 
+Sampled determinism: at ``temperature > 0`` each slot gets its own
+sampling key at admission (split from the engine master key in
+admission order) and token ``i`` is drawn with ``fold_in(slot_key,
+position_i)`` — the key stream is a pure function of (slot key,
+position), independent of batch composition, horizon K, and crashes.
+Persisting the key data per slot makes crash-recovery replay exact for
+sampled requests too: replay teacher-forces the recorded tokens, then
+sampling resumes at the next position with the next key the
+uninterrupted run would have used (``tests/test_serving_faults.py``
+pins byte-parity for a sampled run crashed mid-decode).
+
 Fault tolerance (the DL4J lineage: the reference runtime supervised
 its workers via Akka and rebuilt them from ZooKeeper state; here the
 unit of supervision is the horizon dispatch and the durable state is
@@ -105,6 +116,7 @@ server's hung-engine watchdog.
 
 from __future__ import annotations
 
+import logging
 import time
 
 import jax
@@ -117,6 +129,14 @@ from deeplearning4j_tpu.models.transformer import (
     _chunk_builder,
     _decode_builder,
     _top_k_filter,
+)
+from deeplearning4j_tpu.obs.logs import log_event
+from deeplearning4j_tpu.obs.profiler import ProfileTrigger
+from deeplearning4j_tpu.obs.trace import (
+    ENGINE_TRACK,
+    SCHEDULER_TRACK,
+    Tracer,
+    slot_track,
 )
 from deeplearning4j_tpu.serving.cache_pool import KVSlotPool
 from deeplearning4j_tpu.serving.faults import (
@@ -136,17 +156,22 @@ from deeplearning4j_tpu.serving.scheduler import (
 #: device EOS id for requests without one (never equals a sampled token)
 _NO_EOS = -1
 
+_log = logging.getLogger(__name__)
+
 
 class _SlotState:
     """Host-side record for one occupied slot."""
 
-    __slots__ = ("req", "tokens", "t_first_token", "gen")
+    __slots__ = ("req", "tokens", "t_first_token", "gen", "key_data")
 
-    def __init__(self, req: Request, gen: int):
+    def __init__(self, req: Request, gen: int, key_data):
         self.req = req
         self.tokens: list[int] = []
         self.t_first_token: float | None = None
         self.gen = gen  # pool generation at admission (reuse detection)
+        # raw uint32 data of the slot's sampling key (host-persisted so
+        # crash-recovery replay resumes the exact key stream)
+        self.key_data = key_data
 
 
 class _Inflight:
@@ -189,6 +214,15 @@ class ServingEngine:
     bounds the finished-stream dict (oldest evicted first) so sustained
     traffic cannot leak host memory; front ends should prefer
     :meth:`pop_result`, which removes the entry on read.
+
+    Observability: ``tracer`` (an :class:`~deeplearning4j_tpu.obs
+    .trace.Tracer`) records the request lifecycle as spans — queued on
+    the scheduler track, prefill/decode/first-token/terminal per slot
+    track, dispatch/sync/step on the engine track — defaulting to a
+    DISABLED tracer (every record call is one attribute check);
+    ``profile`` (an :class:`~deeplearning4j_tpu.obs.profiler
+    .ProfileTrigger`) brackets engine steps so an armed XLA capture
+    starts and stops on step boundaries.
     """
 
     def __init__(
@@ -212,6 +246,8 @@ class ServingEngine:
         retry_backoff_s: float = 0.01,
         max_backoff_s: float = 0.25,
         results_cap: int = 1024,
+        tracer: Tracer | None = None,
+        profile: ProfileTrigger | None = None,
     ):
         self.cfg = cfg
         self.n_slots = n_slots
@@ -226,6 +262,10 @@ class ServingEngine:
         self.retry_backoff_s = retry_backoff_s
         self.max_backoff_s = max_backoff_s
         self.results_cap = results_cap
+        # disabled-by-default tracer: every record call is one attribute
+        # check, so leaving it wired costs nothing (see obs.trace)
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.profile = profile
 
         fwd1, init_caches, do_prefill, cast_params = _decode_builder(cfg)
         self._fwd1 = fwd1
@@ -245,6 +285,7 @@ class ServingEngine:
             self.scheduler.max_total_tokens = self.max_total
         self.metrics = metrics or ServingMetrics()
         self.metrics.decode_horizon = self.decode_horizon
+        self._register_gauges()
 
         # power-of-two prompt buckets: the largest must respect the
         # positional table (prefill embeds rows 0..bucket-1) and the
@@ -268,6 +309,19 @@ class ServingEngine:
         self._inflight: _Inflight | None = None
         self._results: dict[str, np.ndarray] = {}
         self._key = jax.random.key(rng_seed)
+        # per-slot sampling keys, split from the master key at
+        # admission (deterministic by admission order). The step
+        # program derives each sampled token's key as
+        # fold_in(slot_key, position) — a pure function of slot key and
+        # position, independent of batch composition or horizon K, so
+        # crash-recovery replay (teacher-force recorded tokens, re-seat
+        # positions and keys) resumes the EXACT key stream an
+        # uninterrupted run would have used. _slot_keys is the raw
+        # uint32 key data, host-side; each _SlotState keeps its row.
+        _kd0 = np.asarray(jax.random.key_data(self._key))
+        self._slot_keys = np.zeros(
+            (n_slots,) + _kd0.shape, _kd0.dtype
+        )
         self._steps = 0
         self._admitting = 0  # requests between scheduler pop and slot
         self.last_dispatch_t: float | None = None  # watchdog heartbeat
@@ -295,6 +349,35 @@ class ServingEngine:
         self._insert_fn = None
         self._admit_donate = (0, 1, 2, 3, 4, 5) if tpu else ()
 
+    def _register_gauges(self) -> None:
+        """Live-state gauges on the metrics registry: scrapes read
+        engine state through callbacks, so the hot path never updates
+        them."""
+        reg = self.metrics.registry
+        reg.gauge(
+            "serve_kv_slots", "KV slot pool size (decode batch width).",
+        ).set_function(lambda: self.n_slots)
+        reg.gauge(
+            "serve_kv_slots_active", "KV slots currently occupied.",
+        ).set_function(lambda: self.pool.n_active)
+        reg.gauge(
+            "serve_kv_occupancy", "Occupied fraction of the slot pool.",
+        ).set_function(lambda: self.pool.occupancy)
+        reg.gauge(
+            "serve_kv_slot_generations",
+            "Total slot acquire count (slot-reuse churn).",
+        ).set_function(
+            lambda: sum(
+                self.pool.generation(s) for s in range(self.n_slots)
+            )
+        )
+        reg.gauge(
+            "serve_kv_cache_bytes", "Device bytes of the pooled KV cache.",
+        ).set_function(lambda: self.pool.nbytes())
+        reg.gauge(
+            "serve_queue_depth", "Requests queued, not yet admitted.",
+        ).set_function(lambda: len(self.scheduler))
+
     # -- compiled programs -------------------------------------------------
 
     def _build_step(self):
@@ -309,9 +392,16 @@ class ServingEngine:
         approx_top_k = self.approx_top_k
         horizon = self.decode_horizon
 
-        def step(params, caches, logits, pos, active, budget, eos, key):
-            subkeys = (
-                jax.random.split(key, horizon) if temperature != 0 else None
+        def step(params, caches, logits, pos, active, budget, eos,
+                 slot_keys_raw):
+            # per-slot keys (raw uint32 rows, host-persisted): token i
+            # of slot s is sampled with fold_in(key_s, position) — a
+            # pure function of the slot's admission key and its stream
+            # position, so the key stream is invariant to batch
+            # composition, horizon K, and crash-recovery replay
+            keys = (
+                jax.random.wrap_key_data(slot_keys_raw)
+                if temperature != 0 else None
             )
             toks_all = []
             for k in range(horizon):
@@ -319,9 +409,10 @@ class ServingEngine:
                 if temperature == 0:
                     toks = jnp.argmax(filt, axis=-1).astype(jnp.int32)
                 else:
-                    toks = jax.random.categorical(
-                        subkeys[k], filt / temperature, axis=-1
-                    ).astype(jnp.int32)
+                    tok_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+                    toks = jax.vmap(
+                        lambda kk, lg: jax.random.categorical(kk, lg)
+                    )(tok_keys, filt / temperature).astype(jnp.int32)
                 # inactive slots decode token 0 at their frozen
                 # position — shape stability; the garbage row they
                 # write stays inside their own slab and is wiped by the
@@ -494,7 +585,19 @@ class ServingEngine:
     def submit(self, req: Request) -> str:
         """Queue a request (see ``RequestScheduler.submit`` for the
         backpressure/admission contract)."""
-        return self.scheduler.submit(req)
+        try:
+            rid = self.scheduler.submit(req)
+        except Backpressure:
+            self.metrics.record_backpressure()
+            self.tracer.instant(
+                SCHEDULER_TRACK, "backpressure", req_id=req.id
+            )
+            raise
+        self.tracer.instant(SCHEDULER_TRACK, "submit", req_id=rid)
+        log_event(_log, "request_submitted", level=logging.DEBUG,
+                  req_id=rid, prompt_len=len(req.prompt),
+                  max_new=req.max_new)
+        return rid
 
     @property
     def results(self) -> dict[str, np.ndarray]:
@@ -576,6 +679,13 @@ class ServingEngine:
         self._slots[slot] = None
         if deactivate:
             self._dactive = self._deact_fn(self._dactive, jnp.int32(slot))
+        self.tracer.instant(
+            slot_track(slot), status.value, ts=now, req_id=req.id,
+            n_tokens=len(st.tokens),
+        )
+        log_event(_log, "request_retired", req_id=req.id, slot=slot,
+                  status=status.value, n_tokens=len(st.tokens),
+                  error=error)
         if req.done is not None:
             req.done.set()
 
@@ -585,6 +695,11 @@ class ServingEngine:
         req.status = status
         req.error = error
         self.metrics.record_outcome(status)
+        self.tracer.instant(
+            SCHEDULER_TRACK, status.value, req_id=req.id
+        )
+        log_event(_log, "request_retired", req_id=req.id, slot=None,
+                  status=status.value, n_tokens=0, error=error)
         if req.done is not None:
             req.done.set()
 
@@ -709,6 +824,7 @@ class ServingEngine:
                     self._retire_unadmitted(req, RequestStatus.EXPIRED)
                     continue
                 slot = self.pool.acquire()
+                t_pf = time.perf_counter()
                 try:
                     ok = self._prefill_with_retries(req, slot)
                 except BaseException:
@@ -719,20 +835,39 @@ class ServingEngine:
                     self.pool.release(slot)
                     self.scheduler.requeue(req)
                     raise
+                t_adm = time.perf_counter()
+                self.metrics.record_prefill(req.id, t_adm - t_pf)
                 if not ok:
                     self.pool.release(slot)
                     self._retire_unadmitted(
                         req, RequestStatus.FAILED, req.error
                     )
                     continue
+                # split the slot's sampling key here (deterministic by
+                # admission order — the same order replay reproduces)
+                self._key, sub = jax.random.split(self._key)
+                kd = np.asarray(jax.random.key_data(sub))
+                self._slot_keys[slot] = kd
                 self._slots[slot] = _SlotState(
-                    req, self.pool.generation(slot)
+                    req, self.pool.generation(slot), kd
                 )
                 req.status = RequestStatus.RUNNING
-                if req.arrival_time is not None:
-                    self.metrics.record_admitted(
-                        req.id, time.perf_counter() - req.arrival_time
+                delay = (time.perf_counter() - req.arrival_time
+                         if req.arrival_time is not None else None)
+                if delay is not None:
+                    self.metrics.record_admitted(req.id, delay)
+                    self.tracer.span(
+                        SCHEDULER_TRACK, "queued", req.arrival_time,
+                        delay, req_id=req.id,
                     )
+                self.tracer.span(
+                    slot_track(slot), "prefill", t_pf, t_adm - t_pf,
+                    req_id=req.id, prompt_len=len(req.prompt),
+                )
+                log_event(_log, "request_admitted", req_id=req.id,
+                          slot=slot, prompt_len=len(req.prompt),
+                          queue_delay_s=delay,
+                          prefill_s=round(t_adm - t_pf, 6))
             finally:
                 self._admitting -= 1
 
@@ -748,20 +883,27 @@ class ServingEngine:
         if not any(st is not None for st in self._slots):
             return None
         attempt, backoff = 0, self.retry_backoff_s
-        self._key, sub = jax.random.split(self._key)
+        t_call = time.perf_counter()
         while True:
             try:
                 if self.faults is not None:
                     self.faults.check("step")
+                # .copy(): jnp.asarray can zero-copy alias the mutable
+                # host key buffer on CPU, and dispatch is async — a
+                # concurrent admission writing a slot key must not race
+                # the in-flight step
                 (self.pool.caches, self._logits, self._dpos,
                  self._dactive, self._dbudget, toks) = self._step_fn(
                     self.params, self.pool.caches, self._logits,
                     self._dpos, self._dactive, self._dbudget,
-                    self._deos, sub,
+                    self._deos, jnp.asarray(self._slot_keys.copy()),
                 )
                 break
             except TransientFault as e:
                 self.metrics.record_retry()
+                self.tracer.instant(
+                    ENGINE_TRACK, "retry", site="step", error=str(e)
+                )
                 attempt += 1
                 if attempt <= self.max_retries:
                     time.sleep(backoff)
@@ -797,6 +939,10 @@ class ServingEngine:
         self.metrics.record_step(
             len(snaps), self.n_slots, len(self.scheduler)
         )
+        self.tracer.span(
+            ENGINE_TRACK, "dispatch", t_call, now - t_call,
+            n_active=len(snaps),
+        )
         return _Inflight(toks, snaps, now)
 
     def _process(self, horizon: _Inflight) -> None:
@@ -812,16 +958,34 @@ class ServingEngine:
             sync_wait_s=now - t_sync,
             overlap_s=max(0.0, t_sync - horizon.t_dispatch),
         )
+        self.tracer.span(ENGINE_TRACK, "sync", t_sync, now - t_sync)
+        # per-slot decode span for this horizon: dispatch → block
+        # arrival, clipped at the NEXT horizon's dispatch (which already
+        # happened — pipelining) so consecutive decode spans on one slot
+        # track stay disjoint in the trace viewer
+        t_span_end = now
+        if (self._inflight is not None
+                and self._inflight.t_dispatch > horizon.t_dispatch):
+            t_span_end = min(now, self._inflight.t_dispatch)
         for slot, st in horizon.snaps:
             if (self._slots[slot] is not st
                     or st.gen != self.pool.generation(slot)):
                 continue  # retired/reused since dispatch: tokens dead
             req = st.req
+            self.tracer.span(
+                slot_track(slot), "decode", horizon.t_dispatch,
+                t_span_end - horizon.t_dispatch, req_id=req.id,
+                k=int(toks_host.shape[1]),
+            )
             finished = False
             for k in range(toks_host.shape[1]):
                 tok = int(toks_host[slot, k])
                 if st.t_first_token is None:
                     st.t_first_token = now
+                    self.tracer.instant(
+                        slot_track(slot), "first_token", ts=now,
+                        req_id=req.id,
+                    )
                     if req.arrival_time is not None:
                         self.metrics.record_first_token(
                             req.id, now - req.arrival_time
@@ -841,15 +1005,36 @@ class ServingEngine:
         overlaps device compute). Returns False when there was nothing
         to do. Raises ``EngineCrash`` when the dispatch loop cannot
         make progress (callers recover via :meth:`recover`)."""
+        prof = self.profile
+        if prof is not None:
+            prof.step_start()
         now = time.perf_counter()
-        self._sweep_lifecycle(now)
-        self._admit(now)
-        prev, self._inflight = self._inflight, self._dispatch()
-        if self._inflight is not None:
-            self._steps += 1
-        if prev is not None:
-            self._process(prev)
-        return prev is not None or self._inflight is not None
+        try:
+            self._sweep_lifecycle(now)
+            self._admit(now)
+            prev, self._inflight = self._inflight, self._dispatch()
+            if self._inflight is not None:
+                self._steps += 1
+            if prev is not None:
+                self._process(prev)
+        finally:
+            if prof is not None:
+                prof.step_end()
+        progressed = prev is not None or self._inflight is not None
+        if self.tracer.enabled and progressed:
+            t_end = time.perf_counter()
+            self.tracer.span(
+                ENGINE_TRACK, "step", now, t_end - now, n=self._steps
+            )
+            self.tracer.counter(
+                SCHEDULER_TRACK, "queue_depth", len(self.scheduler),
+                ts=t_end,
+            )
+            self.tracer.counter(
+                ENGINE_TRACK, "kv_slots_active", self.pool.n_active,
+                ts=t_end,
+            )
+        return progressed
 
     # -- crash recovery ----------------------------------------------------
 
@@ -924,17 +1109,27 @@ class ServingEngine:
         per ``chunked_replay`` (see class docstring; "auto" probes for
         bitwise parity and falls back to stepwise). Queued requests are
         untouched. Returns the number of live requests replayed."""
+        t_rec = time.perf_counter()
         self.metrics.record_restart()
+        self.tracer.instant(ENGINE_TRACK, "crash", ts=t_rec)
         self._inflight = None
         live = [(s, st) for s, st in enumerate(self._slots)
                 if st is not None]
         chunked = bool(live) and self._use_chunked_replay()
         self.pool.reinit()
         self._reset_device_state()
+        # re-seat each live slot's sampling key from its host record —
+        # with position-indexed fold_in sampling this is all it takes
+        # for a temperature>0 stream to resume exactly where it left off
+        self._slot_keys[:] = 0
+        for slot, st in live:
+            self._slot_keys[slot] = st.key_data
         self.last_recover_mode = (
             None if not live else ("chunked" if chunked else "stepwise")
         )
         if not live:
+            log_event(_log, "engine_recovered", mode=None, n_replayed=0,
+                      restarts=self.metrics.n_restarts)
             return 0
         if chunked:
             for slot, st in live:
@@ -947,6 +1142,7 @@ class ServingEngine:
                 self._prefill_seq_into_slot(
                     seq, slot, req.max_new - len(st.tokens), eos_tok
                 )
+            self._log_recovered(t_rec, len(live))
             return len(live)
         pos = np.zeros((self.n_slots,), np.int32)
         for slot, st in live:
@@ -989,7 +1185,19 @@ class ServingEngine:
         self._dactive = jnp.asarray(active)
         self._dbudget = jnp.asarray(budget)
         self._deos = jnp.asarray(eos)
+        self._log_recovered(t_rec, len(live))
         return len(live)
+
+    def _log_recovered(self, t_rec: float, n_replayed: int) -> None:
+        now = time.perf_counter()
+        self.tracer.span(
+            ENGINE_TRACK, "recover", t_rec, now - t_rec,
+            mode=self.last_recover_mode, n_replayed=n_replayed,
+        )
+        log_event(_log, "engine_recovered", mode=self.last_recover_mode,
+                  n_replayed=n_replayed,
+                  restarts=self.metrics.n_restarts,
+                  recover_s=round(now - t_rec, 6))
 
     def fail_all(self, error: str) -> None:
         """Terminal supervision failure: fail every live and queued

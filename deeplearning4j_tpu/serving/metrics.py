@@ -1,8 +1,19 @@
 """Serving latency/utilization metrics.
 
-Records through the existing JSONL :class:`MetricsWriter` (same format
-the trainer's listener emits, so the same grep/plot tooling reads both)
-and keeps in-memory series for percentile summaries:
+Three sinks behind one recording API, so the engine instruments each
+event exactly once:
+
+- a :class:`~deeplearning4j_tpu.obs.registry.MetricsRegistry` of
+  Prometheus counters/histograms (``serve_*`` families) — what the
+  serving server renders at ``GET /metrics`` for a fleet scraper;
+- bounded in-memory :class:`~deeplearning4j_tpu.obs.registry.Reservoir`
+  series for the ``summary()`` percentile view (exact counts/totals,
+  sampled percentiles — a week of traffic costs the same memory as a
+  minute);
+- optionally the JSONL :class:`MetricsWriter` (same format the
+  trainer's listener emits, so the same grep/plot tooling reads both).
+
+The series:
 
 - ``serve/ttft_seconds`` — time-to-first-token per request, measured
   from scheduler arrival (so queue wait counts — that is the number a
@@ -25,6 +36,19 @@ and keeps in-memory series for percentile summaries:
   the device (the pre-pipelining behavior), near 1 means readback is
   fully hidden.
 
+Per-phase accounting: every recorded second is also attributed to one
+of four request phases — ``queue`` (submit → admission), ``prefill``
+(admission prefill wall time), ``decode`` (horizon dispatch → token
+block arrival), ``sync`` (the blocking slice of decode: the host-side
+``np.asarray`` wait) — accumulated exactly in ``phase_seconds`` and
+exported both as a labelled Prometheus histogram
+(``serve_phase_seconds{phase=...}``) and as ``phase_frac`` in
+``summary()``. This is the breakdown that justifies (or kills) tuning
+work: an adaptive decode horizon only pays if ``queue`` dominates, a
+batched same-bucket admission only if ``prefill`` does. Note ``sync``
+is a sub-interval of ``decode`` (fractions tell where time GOES, not a
+partition of wall time).
+
 With a multi-step decode horizon (``decode_horizon`` > 1) a "step" in
 the series above is one K-substep horizon dispatch; TTFT is still
 measured to the host-visible first token, so it honestly includes the
@@ -38,25 +62,38 @@ from __future__ import annotations
 
 import numpy as np
 
+from deeplearning4j_tpu.obs.registry import MetricsRegistry, Reservoir
 from deeplearning4j_tpu.utils.metrics import MetricsWriter
 
+#: the four request phases the per-phase breakdown attributes time to
+PHASES = ("queue", "prefill", "decode", "sync")
 
-def _pct(xs: list[float], p: float) -> float:
-    return float(np.percentile(np.asarray(xs, np.float64), p))
+#: reservoir size for the latency series (uniform sample; exact
+#: n/total/min/max are kept alongside)
+RESERVOIR_CAP = 4096
+
+
+def _pct(res: Reservoir, p: float) -> float:
+    return float(np.percentile(np.asarray(res.values, np.float64), p))
 
 
 class ServingMetrics:
     def __init__(self, writer: MetricsWriter | None = None,
-                 prefix: str = "serve"):
+                 prefix: str = "serve",
+                 registry: MetricsRegistry | None = None,
+                 reservoir_cap: int = RESERVOIR_CAP):
         self.writer = writer
         self.prefix = prefix
-        self.ttft: list[float] = []
-        self.tpot: list[float] = []
-        self.occupancy: list[float] = []
-        self.queue_depth: list[int] = []
-        self.queue_delay: list[float] = []
-        self.sync_wait: list[float] = []
-        self.overlap: list[float] = []
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.ttft = Reservoir(reservoir_cap)
+        self.tpot = Reservoir(reservoir_cap)
+        self.occupancy = Reservoir(reservoir_cap)
+        self.queue_depth = Reservoir(reservoir_cap)
+        self.queue_delay = Reservoir(reservoir_cap)
+        self.sync_wait = Reservoir(reservoir_cap)
+        self.overlap = Reservoir(reservoir_cap)
+        # exact per-phase wall-second totals (see module docstring)
+        self.phase_seconds = {p: 0.0 for p in PHASES}
         # stamped by the engine at construction; reported in summary()
         # so a bench row records which horizon produced its numbers
         self.decode_horizon = 1
@@ -71,18 +108,62 @@ class ServingMetrics:
         self.n_failed = 0
         self.n_cancelled = 0
         self.n_expired = 0
+        self.n_backpressure = 0
         self._step = 0
+
+        # Prometheus instruments (get-or-create: a shared registry can
+        # back several metrics objects without double registration)
+        reg = self.registry
+        self._c_requests = reg.counter(
+            "serve_requests_total",
+            "Terminal request outcomes by status.", ("outcome",),
+        )
+        self._c_tokens = reg.counter(
+            "serve_tokens_generated_total", "Tokens generated (all requests).",
+        )
+        self._c_steps = reg.counter(
+            "serve_engine_steps_total",
+            "Decode horizons dispatched (K substeps each).",
+        )
+        self._c_retries = reg.counter(
+            "serve_retries_total", "Transient-fault boundary retries.",
+        )
+        self._c_restarts = reg.counter(
+            "serve_restarts_total", "Engine rebuilds by deterministic replay.",
+        )
+        self._c_backpressure = reg.counter(
+            "serve_backpressure_total",
+            "Submits rejected at max queue depth (HTTP 429).",
+        )
+        self._h_ttft = reg.histogram(
+            "serve_ttft_seconds",
+            "Time to first token, from scheduler arrival.",
+        )
+        self._h_tpot = reg.histogram(
+            "serve_tpot_seconds", "Time per output token after the first.",
+        )
+        self._h_phase = reg.histogram(
+            "serve_phase_seconds",
+            "Per-event wall seconds by request phase "
+            "(queue|prefill|decode|sync).", ("phase",),
+        )
 
     def _emit(self, tag: str, value: float, step: int | None = None) -> None:
         if self.writer is not None:
             self.writer.scalar(f"{self.prefix}/{tag}", value, step)
 
+    def record_phase(self, phase: str, seconds: float) -> None:
+        """Attribute ``seconds`` of wall time to a request phase."""
+        self.phase_seconds[phase] += seconds
+        self._h_phase.observe(seconds, phase=phase)
+
     def record_step(self, n_active: int, n_slots: int,
                     queue_depth: int) -> None:
         """Per-engine-step utilization sample (``n_active`` slots
         decoding this step, of ``n_slots``)."""
-        self.occupancy.append(float(n_active))
-        self.queue_depth.append(int(queue_depth))
+        self.occupancy.add(float(n_active))
+        self.queue_depth.add(int(queue_depth))
+        self._c_steps.inc()
         self._emit("occupancy", n_active, self._step)
         self._emit("occupancy_frac", n_active / n_slots, self._step)
         self._emit("queue_depth", queue_depth, self._step)
@@ -92,20 +173,30 @@ class ServingMetrics:
         """Request left the queue for a KV slot after ``delay_s``
         seconds of waiting (admission happens at horizon boundaries, so
         this is where decode_horizon > 1 shows up first)."""
-        self.queue_delay.append(float(delay_s))
+        self.queue_delay.add(float(delay_s))
+        self.record_phase("queue", float(delay_s))
         self._emit("queue_delay_seconds", delay_s)
+
+    def record_prefill(self, req_id: str, seconds: float) -> None:
+        """One admission prefill (all bucket/chunk dispatches)."""
+        self.record_phase("prefill", float(seconds))
 
     def record_readback(self, sync_wait_s: float,
                         overlap_s: float) -> None:
         """One horizon readback: host blocked ``sync_wait_s`` on the
-        token sync after ``overlap_s`` of overlapped host work."""
-        self.sync_wait.append(float(sync_wait_s))
-        self.overlap.append(float(overlap_s))
+        token sync after ``overlap_s`` of overlapped host work. The
+        horizon's decode interval (dispatch → block arrival) is their
+        sum."""
+        self.sync_wait.add(float(sync_wait_s))
+        self.overlap.add(float(overlap_s))
+        self.record_phase("decode", float(sync_wait_s) + float(overlap_s))
+        self.record_phase("sync", float(sync_wait_s))
         self._emit("sync_wait_seconds", sync_wait_s)
         self._emit("overlap_seconds", overlap_s)
 
     def record_first_token(self, req_id: str, ttft_s: float) -> None:
-        self.ttft.append(float(ttft_s))
+        self.ttft.add(float(ttft_s))
+        self._h_ttft.observe(ttft_s)
         self._emit("ttft_seconds", ttft_s)
 
     def record_finished(self, req_id: str, n_tokens: int,
@@ -114,25 +205,36 @@ class ServingMetrics:
         seconds spent after the first token."""
         self.n_finished += 1
         self.n_generated += n_tokens
+        self._c_requests.inc(outcome="finished")
+        self._c_tokens.inc(n_tokens)
         if n_tokens > 1:
             tpot = decode_s / (n_tokens - 1)
-            self.tpot.append(tpot)
+            self.tpot.add(tpot)
+            self._h_tpot.observe(tpot)
             self._emit("tpot_seconds", tpot)
 
     def record_retry(self) -> None:
         """One transient-fault retry at an engine boundary."""
         self.n_retries += 1
+        self._c_retries.inc()
         self._emit("retries_total", self.n_retries)
 
     def record_restart(self) -> None:
         """One engine-state rebuild by deterministic replay."""
         self.n_restarts += 1
+        self._c_restarts.inc()
         self._emit("restarts_total", self.n_restarts)
+
+    def record_backpressure(self) -> None:
+        """One submit shed at max queue depth."""
+        self.n_backpressure += 1
+        self._c_backpressure.inc()
 
     def record_outcome(self, status) -> None:
         """Non-FINISHED terminal outcome (status is a
         ``RequestStatus`` or its string value)."""
         s = getattr(status, "value", status)
+        self._c_requests.inc(outcome=s)
         if s == "failed":
             self.n_failed += 1
             self._emit("failed_total", self.n_failed)
@@ -143,8 +245,14 @@ class ServingMetrics:
             self.n_expired += 1
             self._emit("expired_total", self.n_expired)
 
+    def render_prometheus(self) -> str:
+        """The backing registry in Prometheus text format (what the
+        serving server returns at ``GET /metrics``)."""
+        return self.registry.render()
+
     def summary(self) -> dict:
-        """Aggregate view: p50/p99 latencies + mean utilization."""
+        """Aggregate view: p50/p99 latencies + mean utilization +
+        per-phase breakdown."""
         out = {
             "n_finished": self.n_finished,
             "n_generated": self.n_generated,
@@ -162,14 +270,23 @@ class ServingMetrics:
                 out[f"{name}_p50_s"] = _pct(xs, 50)
                 out[f"{name}_p99_s"] = _pct(xs, 99)
         if self.sync_wait:
-            sync = float(np.sum(self.sync_wait))
-            over = float(np.sum(self.overlap))
+            sync = self.sync_wait.total
+            over = self.overlap.total
             out["sync_wait_mean_s"] = sync / len(self.sync_wait)
             if sync + over > 0:
                 out["dispatch_overlap_frac"] = over / (sync + over)
         if self.occupancy:
             # mean slots actually decoding per step — the "effective
             # batch" a continuous batcher is supposed to keep > 1
-            out["occupancy_mean"] = float(np.mean(self.occupancy))
-            out["queue_depth_max"] = int(max(self.queue_depth))
+            out["occupancy_mean"] = self.occupancy.mean
+            out["queue_depth_max"] = int(self.queue_depth.max)
+        attributed = sum(self.phase_seconds.values())
+        if attributed > 0:
+            out["phase_seconds"] = {
+                p: round(v, 6) for p, v in self.phase_seconds.items()
+            }
+            out["phase_frac"] = {
+                p: round(v / attributed, 4)
+                for p, v in self.phase_seconds.items()
+            }
         return out

@@ -25,7 +25,19 @@ Endpoints:
   500 when the request was failed by the fault layer, 504 on handler
   timeout (the request IS cancelled in the engine — its KV slot frees
   within one step, it does not keep decoding for a gone client).
-- ``GET /metrics`` — ``ServingMetrics.summary()`` + live engine state.
+- ``GET /metrics`` — Prometheus text exposition (version 0.0.4) of the
+  engine's metrics registry: request outcomes, retries, restarts,
+  backpressure, queue depth, KV occupancy/churn, TTFT/TPOT and
+  per-phase latency histograms (see :mod:`..serving.metrics` and
+  :mod:`..obs.registry`). Also served standalone on ``metrics_port``
+  when one is configured — a scrape sidecar that keeps working while
+  the main port is saturated with generate traffic.
+- ``GET /metrics.json`` — ``ServingMetrics.summary()`` + live engine
+  state (the human-readable aggregate view).
+- ``POST /profile?s=N`` — arm an XLA profiler capture of the next N
+  engine steps (requires the engine to be wired with a
+  ``ProfileTrigger``; 409 while a capture is already armed). Returns
+  the directory the capture will land in.
 - ``GET /healthz`` — liveness: 200 while the engine thread is alive
   (or recovering), 503 once it is dead OR HUNG; payload carries
   ``engine_alive``, ``last_error``, the restart count, and the
@@ -53,10 +65,13 @@ Text prompts/completions use the repo's byte-level convention
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from http.server import ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu.obs.logs import log_event
 from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.scheduler import (
     AdmissionError,
@@ -67,8 +82,14 @@ from deeplearning4j_tpu.serving.scheduler import (
 from deeplearning4j_tpu.utils.httpjson import (
     QuietHandler,
     read_json_body,
+    send_body,
     send_json,
 )
+
+_log = logging.getLogger(__name__)
+
+#: Prometheus text exposition format version served at /metrics
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 #: HTTP status for each non-FINISHED terminal request state
 _STATUS_HTTP = {
@@ -83,7 +104,8 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, request_timeout_s: float = 300.0,
-                 max_restarts: int = 5, hang_threshold_s: float = 120.0):
+                 max_restarts: int = 5, hang_threshold_s: float = 120.0,
+                 metrics_port: int | None = None):
         self.engine = engine
         self.request_timeout_s = request_timeout_s
         self.max_restarts = max_restarts
@@ -96,25 +118,29 @@ class ServingServer:
         # iteration, so a loop wedged INSIDE step() (e.g. a device call
         # that never returns) stops beating while its thread stays alive
         self._last_beat: float | None = None
+        # server-level gauges on the engine's registry, so one scrape
+        # carries engine AND supervisor state
+        reg = engine.metrics.registry
+        reg.gauge(
+            "serve_engine_alive",
+            "1 while the supervised engine loop is considered live.",
+        ).set_function(lambda: float(self._health_payload()["ok"]))
+        reg.gauge(
+            "serve_draining", "1 while the server is draining.",
+        ).set_function(lambda: float(self._draining.is_set()))
         server = self
 
         class Handler(QuietHandler):
             def do_GET(self):
-                if self.path == "/healthz":
-                    payload = server._health_payload()
-                    send_json(self, 200 if payload["ok"] else 503, payload)
-                elif self.path == "/readyz":
-                    payload = server._health_payload()
-                    ready = payload["ok"] and not payload["draining"]
-                    payload["ready"] = ready
-                    send_json(self, 200 if ready else 503, payload)
-                elif self.path == "/metrics":
-                    send_json(self, 200, server._metrics_payload())
-                else:
+                if not server._common_get(self):
                     send_json(self, 404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/v1/generate":
+                path = urlparse(self.path).path
+                if path == "/profile":
+                    server._handle_profile(self)
+                    return
+                if path != "/v1/generate":
                     send_json(self, 404, {"error": "not found"})
                     return
                 if server._draining.is_set() or server._stop.is_set():
@@ -147,11 +173,15 @@ class ServingServer:
                     # cancel in the engine so the slot stops decoding
                     # for a client that is about to get a timeout
                     req.cancel()
+                    log_event(_log, "request_completed", req_id=req.id,
+                              http=504, status="timeout")
                     send_json(self, 504, {"error": "generation timed out"})
                     return
                 if req.status is not RequestStatus.FINISHED:
                     code = _STATUS_HTTP.get(req.status, 500)
                     server.engine.pop_result(req.id)  # drop partial stream
+                    log_event(_log, "request_completed", req_id=req.id,
+                              http=code, status=req.status.value)
                     send_json(self, code, {
                         "id": req.id,
                         "status": req.status.value,
@@ -159,6 +189,9 @@ class ServingServer:
                     })
                     return
                 toks = server.engine.pop_result(req.id).tolist()
+                log_event(_log, "request_completed", req_id=req.id,
+                          http=200, status="finished",
+                          n_tokens=len(toks) - len(req.prompt))
                 out = {"id": req.id, "tokens": toks}
                 if server._byte_vocab():
                     out["text"] = bytes(
@@ -174,9 +207,86 @@ class ServingServer:
             target=self._httpd.serve_forever, daemon=True
         )
 
+        # optional scrape sidecar: /metrics (+ health) on its own port,
+        # isolated from generate traffic saturating the main listener
+        self._metrics_httpd = None
+        self._metrics_thread = None
+        if metrics_port is not None:
+
+            class MetricsHandler(QuietHandler):
+                def do_GET(self):
+                    if not server._common_get(self):
+                        send_json(self, 404, {"error": "not found"})
+
+            self._metrics_httpd = ThreadingHTTPServer(
+                (host, metrics_port), MetricsHandler
+            )
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_httpd.serve_forever, daemon=True
+            )
+
     @property
     def address(self) -> tuple[str, int]:
         return self._httpd.server_address[:2]
+
+    @property
+    def metrics_address(self) -> tuple[str, int] | None:
+        """(host, port) of the metrics sidecar, or None when not
+        configured."""
+        if self._metrics_httpd is None:
+            return None
+        return self._metrics_httpd.server_address[:2]
+
+    def _common_get(self, handler) -> bool:
+        """Serve the observability GET endpoints (shared between the
+        main listener and the metrics sidecar). Returns False for an
+        unknown path."""
+        path = urlparse(handler.path).path
+        if path == "/healthz":
+            payload = self._health_payload()
+            send_json(handler, 200 if payload["ok"] else 503, payload)
+        elif path == "/readyz":
+            payload = self._health_payload()
+            ready = payload["ok"] and not payload["draining"]
+            payload["ready"] = ready
+            send_json(handler, 200 if ready else 503, payload)
+        elif path == "/metrics":
+            send_body(
+                handler, 200,
+                self.engine.metrics.render_prometheus().encode(),
+                PROM_CONTENT_TYPE,
+            )
+        elif path == "/metrics.json":
+            send_json(handler, 200, self._metrics_payload())
+        else:
+            return False
+        return True
+
+    def _handle_profile(self, handler) -> None:
+        """``POST /profile?s=N``: arm an XLA capture of the next N
+        engine steps."""
+        trigger = self.engine.profile
+        if trigger is None:
+            send_json(handler, 503, {
+                "error": "no ProfileTrigger configured "
+                         "(start the server with profiling wired)",
+            })
+            return
+        qs = parse_qs(urlparse(handler.path).query)
+        try:
+            n = int(qs.get("s", ["1"])[0])
+            if n < 1:
+                raise ValueError
+        except ValueError:
+            send_json(handler, 400, {"error": "s must be an int >= 1"})
+            return
+        try:
+            capture_dir = trigger.arm(n)
+        except RuntimeError as e:  # already armed
+            send_json(handler, 409, {"error": str(e)})
+            return
+        log_event(_log, "profile_armed", steps=n, dir=str(capture_dir))
+        send_json(handler, 200, {"armed": n, "dir": str(capture_dir)})
 
     def _byte_vocab(self) -> bool:
         return self.engine.cfg.vocab_size <= 256
@@ -292,6 +402,8 @@ class ServingServer:
     def start(self) -> "ServingServer":
         self._engine_thread.start()
         self._http_thread.start()
+        if self._metrics_thread is not None:
+            self._metrics_thread.start()
         return self
 
     def stop(self, drain_s: float = 0.0) -> None:
@@ -335,6 +447,9 @@ class ServingServer:
                 pass
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()
 
     def serve_forever(self, drain_s: float = 0.0) -> None:
         """Blocking convenience for the CLI; Ctrl-C drains for

@@ -369,9 +369,14 @@ def test_http_server_roundtrip():
             )
         status, body = post({"prompt": [0] * 40, "max_new": 8})
         assert status == 400 and "budget" in body["error"]
-        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        with urllib.request.urlopen(f"{base}/metrics.json", timeout=10) as r:
             m = json.loads(r.read())
         assert m["n_finished"] >= 2 and "ttft_p50_s" in m
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            prom = r.read().decode()
+        assert 'serve_requests_total{outcome="finished"} 2' in prom
+        assert "# TYPE serve_ttft_seconds histogram" in prom
         with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
             hz = json.loads(r.read())
         assert hz["ok"] is True and hz["engine_alive"] is True

@@ -242,6 +242,40 @@ def test_chaos_parity_at_multi_step_horizon(horizon):
     assert all(r.status is RequestStatus.FINISHED for r in reqs2)
 
 
+def test_sampled_crash_recovery_key_continuity():
+    """Crash mid-decode at temperature > 0: each slot's sampling key is
+    split at admission and persisted host-side, and token i is drawn
+    with fold_in(slot_key, position) — so after replay (teacher-forced
+    recorded tokens, keys re-seated) the resumed SAMPLED stream is
+    byte-identical to an uninterrupted sampled run. This closes the
+    key-stream-continuity gap stepwise replay alone could not (a shared
+    per-dispatch key would have advanced differently)."""
+    def build(faults=None):
+        return ServingEngine(
+            CFG, _params(), n_slots=3, temperature=0.8, top_k=8,
+            rng_seed=21, faults=faults, retry_backoff_s=0.001,
+            max_backoff_s=0.004,
+        )
+
+    reqs = _requests(6, seed=17)
+    clean_eng = build()
+    for r in reqs:
+        clean_eng.submit(r)
+    clean = clean_eng.run()
+
+    for horizon_crash_at in (1, 3):
+        reqs2 = _clone(reqs)
+        inj = FaultInjector().plan("step", at=horizon_crash_at,
+                                   kind="crash")
+        engine = build(inj)
+        for r in reqs2:
+            engine.submit(r)
+        faulted = engine.run()
+        assert engine.metrics.n_restarts == 1
+        assert all(r.status is RequestStatus.FINISHED for r in reqs2)
+        _assert_parity(reqs, clean, reqs2, faulted)
+
+
 def test_crash_with_unsynced_horizon_drops_no_tokens():
     """Crash while a dispatched horizon is still awaiting readback: its
     tokens were never recorded, so replay regenerates them — no
@@ -497,7 +531,7 @@ def test_server_timeout_cancels_request_and_frees_slot():
             time.sleep(0.01)
         assert engine.pool.n_active == 0
         assert engine.metrics.n_cancelled == 1
-        status, m = _get(base, "/metrics")
+        status, m = _get(base, "/metrics.json")
         assert m["n_cancelled"] == 1 and m["slots_active"] == 0
     finally:
         srv.stop()

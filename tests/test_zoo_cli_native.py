@@ -254,3 +254,89 @@ def test_prefetcher_epoch_label_at_exact_boundary():
 
     with _pytest.raises(RuntimeError):
         loader.next_batch()
+
+
+def test_cli_serve_demo_observability_smoke(tmp_path, capsys):
+    """`serve --demo` end to end with every observability flag on: the
+    port file publishes the bound addresses (--port 0), /v1/generate
+    answers over HTTP, /metrics serves Prometheus text on the main port
+    AND the sidecar, the shutdown path writes a loadable Chrome-trace
+    JSON, and --log-json emits req_id-correlated JSON lines."""
+    import json
+    import logging
+    import threading
+    import time
+    import urllib.request
+
+    from deeplearning4j_tpu.cli import main
+
+    port_file = tmp_path / "ports.json"
+    trace_out = tmp_path / "trace.json"
+    rc = {}
+
+    def run():
+        rc["code"] = main([
+            "serve", "--demo", "--port", "0",
+            "--d-model", "32", "--n-layers", "1", "--n-heads", "2",
+            "--seq-len", "32", "--slots", "2", "--decode-horizon", "1",
+            "--temperature", "0", "--run-seconds", "12", "--drain-s", "5",
+            "--port-file", str(port_file),
+            "--trace-out", str(trace_out),
+            "--log-json",
+            "--metrics-port", "0",
+            "--profile-dir", str(tmp_path / "prof"),
+        ])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 30
+        while not port_file.exists() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert port_file.exists(), "serve never published its port file"
+        ports = json.loads(port_file.read_text())
+        base = f"http://{ports['host']}:{ports['port']}"
+        side = f"http://{ports['host']}:{ports['metrics_port']}"
+
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"prompt": "hi", "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert r.status == 200
+        assert len(out["tokens"]) == 2 + 4
+        assert "text" in out  # --demo is the byte-vocab model
+
+        for b in (base, side):
+            with urllib.request.urlopen(f"{b}/metrics", timeout=10) as r:
+                prom = r.read().decode()
+            assert "version=0.0.4" in r.headers.get("Content-Type")
+            assert 'serve_requests_total{outcome="finished"} 1' in prom
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        t.join(timeout=120)
+        # --log-json attached a process-global handler; detach it
+        pkg = logging.getLogger("deeplearning4j_tpu")
+        for h in list(pkg.handlers):
+            pkg.removeHandler(h)
+        pkg.setLevel(logging.NOTSET)
+    assert not t.is_alive(), "serve did not exit after --run-seconds"
+    assert rc["code"] == 0
+
+    doc = json.loads(trace_out.read_text())
+    span_names = {
+        e["name"] for e in doc["traceEvents"] if e["ph"] == "X"
+    }
+    assert {"step", "prefill", "decode", "queued"} <= span_names
+
+    err = capsys.readouterr().err
+    logged = [json.loads(ln) for ln in err.splitlines()
+              if ln.strip().startswith("{")]
+    admitted = [r for r in logged if r["event"] == "request_admitted"]
+    assert admitted and "req_id" in admitted[0]
+    completed = [r for r in logged if r["event"] == "request_completed"]
+    assert completed and completed[0]["req_id"] == admitted[0]["req_id"]
+    assert completed[0]["http"] == 200
