@@ -67,6 +67,11 @@ per workload — the driver's round record captures all of them:
                   retry/backoff path and pins that throughput
                   degradation under faults is bounded
                   (``degradation_frac`` vs the clean replay in-row)
+- ``transformer-decode-serve-prefix`` the serve trace with a swept
+                  fraction of requests sharing one long prompt prefix,
+                  served through the radix-tree prefix cache: headlines
+                  TTFT p50 and prefill-tokens-saved, with the
+                  cache-off replay in-row pricing what reuse buys
 
 ``--model X`` runs a single workload. ``--scaling`` reports 1->N-chip
 data-parallel efficiency (lenet/alexnet); ``--profile DIR`` captures an
@@ -890,6 +895,121 @@ def _bench_decode_serve(args, n_slots: int = 16, n_requests: int = 48,
     return tok_per_sec, metric, extra
 
 
+def _bench_decode_serve_prefix(args, n_slots: int = 16,
+                               n_requests: int = 48,
+                               mean_interarrival_s: float = 0.01):
+    """Serving under shared-prefix traffic with the radix-tree prefix
+    cache: the serve trace re-run with a FRACTION of the requests
+    sharing one long common prompt prefix (system-prompt traffic),
+    swept over {0, 0.5, 0.9}. Each swept point runs with the cache ON;
+    the 0.9 point also replays with the cache OFF so the row prices
+    exactly what reuse buys. Headlines are TTFT p50 (the user-visible
+    number a cached prefill shortens) and ``prefill_tokens_saved`` (the
+    prompt rows admission never recomputed); the reported metric value
+    is the cached 0.9-fraction aggregate tok/s. Byte-parity of cache
+    on/off streams is pinned by tests/test_serving_prefix.py — this row
+    only prices it."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import init_transformer
+    from deeplearning4j_tpu.serving import (
+        Request,
+        RequestScheduler,
+        ServingEngine,
+        ServingMetrics,
+        run_request_trace,
+    )
+
+    cfg, _, p = _decode_bench_cfg(args, batch=1, gqa=True)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    # one shared prefix, bucket-grain aligned so partial hits reuse it
+    # in full; unique suffixes keep every request's stream distinct
+    sfx_len = 64
+    pfx_len = _DECODE_PROMPT_LEN - sfx_len
+    shared = rng.integers(0, p["vocab"], (pfx_len,)).astype(np.int32)
+    uniq = rng.integers(
+        0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
+    ).astype(np.int32)
+
+    def make_trace(frac):
+        reqs = []
+        for i in range(n_requests):
+            if i < int(round(frac * n_requests)):
+                prompt = np.concatenate([shared, uniq[i, :sfx_len]])
+            else:
+                prompt = uniq[i]
+            reqs.append(
+                (float(arrivals[i]),
+                 Request(prompt=prompt, max_new=_DECODE_NEW))
+            )
+        return reqs
+
+    def make_engine(cache):
+        return ServingEngine(
+            cfg, params, n_slots=n_slots,
+            temperature=1.0, top_k=40,
+            approx_top_k=not args.exact_top_k,
+            prefix_cache=cache,
+            scheduler=RequestScheduler(max_queue_depth=n_requests),
+        )
+
+    def timed(engine, frac):
+        trace = make_trace(frac)
+        t0 = time.perf_counter()
+        results = run_request_trace(engine, trace)
+        dt = time.perf_counter() - t0
+        assert all(r.id in results for _, r in trace)
+        s = engine.metrics.summary()
+        return s["n_generated"] / dt, s
+
+    def point(engine, frac):
+        # warmup replay compiles this engine's programs (and, cache on,
+        # runs the one-time parity probes), then metrics reset + timed
+        run_request_trace(engine, make_trace(frac))
+        if engine.prefix_cache is not None:
+            engine.prefix_cache.reinit()
+        engine.metrics = ServingMetrics()
+        engine.metrics.decode_horizon = engine.decode_horizon
+        return timed(engine, frac)
+
+    sweep = {}
+    for frac in (0.0, 0.5, 0.9):
+        tps, s = point(make_engine(True), frac)
+        sweep[frac] = {
+            "tok_per_sec": round(tps, 1),
+            "ttft_p50_s": round(s["ttft_p50_s"], 4),
+            "ttft_p99_s": round(s["ttft_p99_s"], 4),
+            "prefill_tokens_saved": s.get("prefix_tokens_saved", 0),
+            "prefix_hit_rate": round(s.get("prefix_hit_rate", 0.0), 3),
+        }
+    off_tps, off_s = point(make_engine(False), 0.9)
+    hot = sweep[0.9]
+    tok_per_sec = hot["tok_per_sec"]
+    extra = {
+        "ttft_p50_s": hot["ttft_p50_s"],
+        "ttft_p99_s": hot["ttft_p99_s"],
+        "prefill_tokens_saved": hot["prefill_tokens_saved"],
+        "prefix_hit_rate": hot["prefix_hit_rate"],
+        "shared_prefix_frac": 0.9,
+        "shared_prefix_sweep": {
+            str(f): v for f, v in sweep.items()
+        },
+        "no_cache_tok_per_sec": round(off_tps, 1),
+        "no_cache_ttft_p50_s": round(off_s["ttft_p50_s"], 4),
+        "ttft_p50_speedup": round(
+            off_s["ttft_p50_s"] / max(hot["ttft_p50_s"], 1e-9), 3
+        ),
+        "n_slots": n_slots,
+        "n_requests": n_requests,
+    }
+    metric = ("transformer_gpt2s_h128_decode_serve_prefix_"
+              "tokens_per_sec_per_chip")
+    return tok_per_sec, metric, extra
+
+
 def _bench_resnet(args):
     """ResNet-20 (He CIFAR recipe) training throughput — the modern CNN
     family the reference's era lacked (its conv story stops at
@@ -977,6 +1097,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-b1-spec",
     "transformer-decode-gqa-8kctx", "transformer-decode-gqa-8kctx-int8",
     "transformer-decode-serve", "transformer-decode-serve-faults",
+    "transformer-decode-serve-prefix",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -1000,6 +1121,7 @@ _AUTO_DTYPE = {
     "transformer-decode-gqa-8kctx-int8": "bf16",
     "transformer-decode-serve": "bf16",
     "transformer-decode-serve-faults": "bf16",
+    "transformer-decode-serve-prefix": "bf16",
 }
 
 
@@ -1108,6 +1230,12 @@ def _run_one_inner(args, jax) -> None:
     if args.model.startswith("transformer-decode"):
         if args.scaling:
             raise SystemExit("--scaling does not apply to decode")
+        if args.model == "transformer-decode-serve-prefix":
+            per_chip, metric, extra = _bench_decode_serve_prefix(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (
+                        _bench_decode_serve_prefix(args)[0], None))
+            return
         if args.model in ("transformer-decode-serve",
                           "transformer-decode-serve-faults"):
             # fixed injected transient-fault rate for the chaos row: high

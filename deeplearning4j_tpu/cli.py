@@ -495,7 +495,13 @@ def cmd_serve(args) -> int:
         temperature=args.temperature,
         top_k=args.top_k if args.top_k > 0 else None,
         decode_horizon=args.decode_horizon,
-        scheduler=RequestScheduler(max_queue_depth=args.max_queue),
+        adaptive_horizon=args.adaptive_horizon,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_tokens=args.prefix_cache_tokens,
+        scheduler=RequestScheduler(
+            max_queue_depth=args.max_queue,
+            prefix_affinity_tokens=args.prefix_affinity_tokens,
+        ),
         rng_seed=args.seed,
         faults=faults,
         tracer=tracer,
@@ -511,8 +517,14 @@ def cmd_serve(args) -> int:
     host, port = server.address
     print(f"serving on http://{host}:{port}  "
           f"({args.slots} slots, {engine.max_total} tokens/slot, "
-          f"decode horizon {engine.decode_horizon}, "
+          f"decode horizon {engine.decode_horizon}"
+          f"{' (adaptive)' if args.adaptive_horizon else ''}, "
           f"queue depth {args.max_queue}, drain {args.drain_s:g}s)")
+    if engine.prefix_cache is not None:
+        pc = engine.prefix_cache
+        print(f"prefix cache: {pc.capacity_tokens} tokens "
+              f"({pc.n_region_slots} segments, "
+              f"{pc.nbytes() / 1e6:.1f} MB region)")
     if server.metrics_address is not None:
         mh, mp = server.metrics_address
         print(f"metrics sidecar on http://{mh}:{mp}/metrics")
@@ -739,6 +751,30 @@ def main(argv: list[str] | None = None) -> int:
                    "admission/first-token latency. 1 = per-step "
                    "cadence. bench serve sweeps K and reports the "
                    "winning horizon")
+    v.add_argument("--adaptive-horizon", action="store_true",
+                   help="shrink the decode horizon to 1 while requests "
+                   "wait in the queue (admissions happen at horizon "
+                   "boundaries) and restore --decode-horizon when it "
+                   "drains; token streams are unchanged")
+    v.add_argument("--prefix-cache", action="store_true",
+                   help="radix-tree KV prefix cache: admissions whose "
+                   "prompt shares a cached prefix copy those KV rows "
+                   "instead of recomputing them (gated by a one-time "
+                   "bitwise parity probe; falls back to full prefill). "
+                   "Hit rate and saved prefill tokens appear in "
+                   "/metrics")
+    v.add_argument("--prefix-cache-tokens", type=int, default=None,
+                   metavar="N",
+                   help="device-side prefix-cache capacity in tokens "
+                   "(default: slots x tokens-per-slot, i.e. a region "
+                   "as large as the slot pool)")
+    v.add_argument("--prefix-affinity-tokens", type=int, default=0,
+                   metavar="K",
+                   help="scheduler promotes a queued request whose "
+                   "first K prompt tokens match the previous admission "
+                   "(same priority class only), so shared-prefix "
+                   "requests land in the same admission batch; 0 = "
+                   "plain FIFO")
     v.add_argument("--drain-s", type=float, default=5.0,
                    help="graceful-drain window on shutdown: admission "
                    "stops (503) and in-flight requests get this many "

@@ -941,7 +941,12 @@ def _decode_builder(cfg: TransformerConfig):
         prompt to a length bucket (the serving engine) pass the true
         last-token index. Causal masking makes the padded rows
         invisible to rows <= last_idx, so the logits are bitwise
-        identical to an exact-length prefill.
+        identical to an exact-length prefill. A (B,) VECTOR ``last_idx``
+        selects a per-row last index — the batched-admission path,
+        where rows of one dispatch carry prompts of different true
+        lengths inside the same bucket; the per-row gather copies the
+        same values the scalar program reads, so logits stay row-wise
+        bitwise identical to B=1 prefills.
         """
         b, tp = prompt.shape
         if tp == 0:
@@ -1029,6 +1034,10 @@ def _decode_builder(cfg: TransformerConfig):
         x, kv_all = lax.scan(layer, x, (params["blocks"], kv_all))
         if last_idx is None:
             x_last = x[:, -1]
+        elif jnp.ndim(last_idx) == 1:
+            x_last = jnp.take_along_axis(
+                x, last_idx[:, None, None], axis=1
+            )[:, 0]
         else:
             x_last = lax.dynamic_index_in_dim(
                 x, last_idx, axis=1, keepdims=False
@@ -1348,10 +1357,17 @@ def _chunk_builder(cfg: TransformerConfig):
             x, kv_all = _block_chunk(cfg, x, p_i, kv_all, i, pos0)
         if last_idx is not None:
             # single-row logits (bucketed-prefill chunking: only the
-            # true last token's row matters; skips the (C, V) head)
-            x_last = lax.dynamic_index_in_dim(
-                x, last_idx, axis=1, keepdims=False
-            )
+            # true last token's row matters; skips the (C, V) head).
+            # Vector last_idx = per-row last index, for the batched
+            # suffix-prefill of prefix-cache hits.
+            if jnp.ndim(last_idx) == 1:
+                x_last = jnp.take_along_axis(
+                    x, last_idx[:, None, None], axis=1
+                )[:, 0]
+            else:
+                x_last = lax.dynamic_index_in_dim(
+                    x, last_idx, axis=1, keepdims=False
+                )
             x_last = _layer_norm(
                 x_last, params["lnf_scale"], params["lnf_bias"]
             )

@@ -19,6 +19,10 @@ Public surface:
   HTTP-JSON front end with graceful drain, health/readiness endpoints,
   Prometheus ``/metrics`` (+ optional sidecar port), and on-demand XLA
   profiling (``POST /profile``).
+- :class:`~deeplearning4j_tpu.serving.prefix_cache.PrefixCache` —
+  token-level radix tree mapping prompt prefixes to cached KV segments
+  in a bounded device-side region (refcounted LRU), so admissions that
+  share a prefix skip recomputing it (``--prefix-cache``).
 - :class:`~deeplearning4j_tpu.serving.faults.FaultInjector` —
   deterministic (seeded or scripted) fault injection at engine
   boundaries, driving the supervised step loop / replay recovery
@@ -37,6 +41,7 @@ from deeplearning4j_tpu.serving.faults import (  # noqa: F401
     TransientFault,
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Backpressure,

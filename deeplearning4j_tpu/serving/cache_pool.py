@@ -85,6 +85,16 @@ class KVSlotPool:
         self._in_use.remove(slot)
         heapq.heappush(self._free, slot)
 
+    def alloc_region(self, n_slots: int):
+        """A second bounded cache region with the SAME per-slot layout
+        as the pool — Tpad row count, dtype, int8 scale planes — so a
+        region slab and a pool slab are interchangeable under plain
+        dynamic slices. This is how the prefix cache gets its segment
+        store: the pool owns the layout, the cache owns the slots."""
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        return self._init_caches(n_slots, self._max_total)
+
     def reinit(self) -> None:
         """Re-create the pooled cache buffers, zeroed (crash recovery:
         after an engine-loop crash the old buffers must be assumed

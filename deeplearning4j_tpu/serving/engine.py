@@ -146,6 +146,7 @@ from deeplearning4j_tpu.serving.faults import (
     TransientFault,
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache, Segment
 from deeplearning4j_tpu.serving.scheduler import (
     Backpressure,
     Request,
@@ -162,7 +163,8 @@ _log = logging.getLogger(__name__)
 class _SlotState:
     """Host-side record for one occupied slot."""
 
-    __slots__ = ("req", "tokens", "t_first_token", "gen", "key_data")
+    __slots__ = ("req", "tokens", "t_first_token", "gen", "key_data",
+                 "segs")
 
     def __init__(self, req: Request, gen: int, key_data):
         self.req = req
@@ -172,6 +174,30 @@ class _SlotState:
         # raw uint32 data of the slot's sampling key (host-persisted so
         # crash-recovery replay resumes the exact key stream)
         self.key_data = key_data
+        # prefix-cache segments this request pins (the one its
+        # admission read + the one its prompt inserted); unpinned at
+        # retirement so LRU eviction can reclaim them
+        self.segs: list[Segment] = []
+
+
+class _AdmitPlan:
+    """One admission being planned: the popped request, its acquired
+    slot, and the prefix-cache classification (``kind`` in
+    miss/partial/full, ``seg`` the pinned source segment, ``matched``
+    the usable grain-aligned cached-token count)."""
+
+    __slots__ = ("req", "slot", "kind", "seg", "matched", "admitted",
+                 "prefill_s", "t_pf")
+
+    def __init__(self, req: Request, slot: int):
+        self.req = req
+        self.slot = slot
+        self.kind = "miss"
+        self.seg: Segment | None = None
+        self.matched = 0
+        self.admitted = False  # slot state seated (crash requeue guard)
+        self.prefill_s = 0.0
+        self.t_pf = 0.0
 
 
 class _Inflight:
@@ -236,8 +262,13 @@ class ServingEngine:
         top_k: int | None = None,
         approx_top_k: bool = False,
         decode_horizon: int = 1,
+        adaptive_horizon: bool = False,
         prefill_max_bucket: int = 128,
         chunked_replay: bool | str = "auto",
+        batch_admission: bool | str = "auto",
+        prefix_cache: bool = False,
+        prefix_cache_tokens: int | None = None,
+        prefix_affinity_tokens: int = 0,
         scheduler: RequestScheduler | None = None,
         metrics: ServingMetrics | None = None,
         rng_seed: int = 0,
@@ -256,7 +287,15 @@ class ServingEngine:
         self.top_k = top_k
         self.approx_top_k = approx_top_k
         self.decode_horizon = max(1, int(decode_horizon))
+        # adaptive horizon: shrink K to 1 while requests wait in the
+        # queue (admissions happen at horizon boundaries, so a hot
+        # queue wants short horizons), restore the configured K when it
+        # drains. The device stopping rule is per-substep, so horizon
+        # partitioning never changes token streams (K-parity tests).
+        self.adaptive_horizon = bool(adaptive_horizon)
+        self.decode_horizon_current = self.decode_horizon
         self.chunked_replay = chunked_replay
+        self.batch_admission = batch_admission
         self.faults = faults
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
@@ -279,13 +318,13 @@ class ServingEngine:
 
         self.pool = KVSlotPool(cfg, n_slots, self.max_total)
         self.scheduler = scheduler or RequestScheduler(
-            max_total_tokens=self.max_total
+            max_total_tokens=self.max_total,
+            prefix_affinity_tokens=prefix_affinity_tokens,
         )
         if self.scheduler.max_total_tokens is None:
             self.scheduler.max_total_tokens = self.max_total
         self.metrics = metrics or ServingMetrics()
         self.metrics.decode_horizon = self.decode_horizon
-        self._register_gauges()
 
         # power-of-two prompt buckets: the largest must respect the
         # positional table (prefill embeds rows 0..bucket-1) and the
@@ -296,6 +335,27 @@ class ServingEngine:
             mb *= 2
         self._max_bucket = mb
         self._min_bucket = min(8, mb)
+
+        # prefix cache: radix tree over a bounded segment region with
+        # the pool's slab layout (see serving.prefix_cache). Partial
+        # hits are rounded DOWN to the bucket grain (_min_bucket) so
+        # every suffix chunk window starts sublane-aligned and provably
+        # fits Tpad. Hit-path reuse is gated by a one-time bitwise
+        # parity probe (_prefix_reuse_ok), mirroring chunked_replay
+        # "auto": when the probe fails, every lookup is treated as a
+        # miss and admission falls back to the full prefill path.
+        self.prefix_cache: PrefixCache | None = None
+        if prefix_cache:
+            self.prefix_cache = PrefixCache(
+                self.pool,
+                (prefix_cache_tokens if prefix_cache_tokens is not None
+                 else n_slots * self.pool.tpad),
+                on_evict=self._on_prefix_evict,
+                # branch-point segments shorter than the bucket grain
+                # can never serve a hit (partial matches round down)
+                min_seg_len=self._min_bucket,
+            )
+        self._register_gauges()
 
         # per-slot decode state, DEVICE-resident (threaded through the
         # fused step so pipelined dispatch never reads stale host state)
@@ -326,16 +386,23 @@ class ServingEngine:
         self._admitting = 0  # requests between scheduler pop and slot
         self.last_dispatch_t: float | None = None  # watchdog heartbeat
         self._chunked_ok: bool | None = None  # replay parity probe memo
+        self._prefix_ok_memo: bool | None = None  # hit-path parity memo
+        self._batch_ok_memo: bool | None = None   # batched-path memo
         self.last_recover_mode: str | None = None
+        # programs that COMPUTE prompt rows (bucketed prefill, chunk
+        # windows, batched prefill groups) — a pure-copy admission
+        # (full prefix hit: segment slab + stored logits) dispatches
+        # none, which tests assert on. Probes do not count.
+        self.prefill_dispatches = 0
 
         # donating the cache + per-slot state lets XLA update them in
         # place (the cache is the dominant allocation); CPU jit can't
         # alias donated buffers and would warn every call
         tpu = jax.devices()[0].platform == "tpu"
         self._state_donate = (1, 2, 3, 4, 5) if tpu else ()
-        self._step_fn = jax.jit(
-            self._build_step(), donate_argnums=self._state_donate
-        )
+        # one compiled step program per horizon ACTUALLY used: just
+        # {K} static, {1, K} with the adaptive horizon
+        self._step_fns: dict[int, object] = {}
         self._replay_fn = jax.jit(
             self._build_replay_step(),
             donate_argnums=(1, 2) if tpu else (),
@@ -346,7 +413,13 @@ class ServingEngine:
         )
         self._prefill_fns: dict[int, object] = {}
         self._chunk_fns: dict[int, object] = {}
+        self._batch_prefill_fns: dict[tuple[int, int], object] = {}
+        self._batch_hit_fns: dict[tuple[int, int], object] = {}
         self._insert_fn = None
+        self._hit_insert_fn = None
+        self._seg_store_fn = None
+        self._seg_fetch_fn = None
+        self._logit_row_fn = None
         self._admit_donate = (0, 1, 2, 3, 4, 5) if tpu else ()
 
     def _register_gauges(self) -> None:
@@ -377,10 +450,55 @@ class ServingEngine:
         reg.gauge(
             "serve_queue_depth", "Requests queued, not yet admitted.",
         ).set_function(lambda: len(self.scheduler))
+        reg.gauge(
+            "serve_decode_horizon_current",
+            "Decode substeps fused into the next horizon dispatch "
+            "(shrinks to 1 under adaptive_horizon while the queue is "
+            "non-empty).",
+        ).set_function(lambda: self.decode_horizon_current)
+        if self.prefix_cache is not None:
+            reg.gauge(
+                "serve_prefix_segments", "Cached prefix segments.",
+            ).set_function(lambda: self.prefix_cache.n_segments)
+            reg.gauge(
+                "serve_prefix_segments_pinned",
+                "Segments pinned by in-flight requests (not evictable).",
+            ).set_function(lambda: self.prefix_cache.n_pinned)
+            reg.gauge(
+                "serve_prefix_tokens_cached",
+                "Prompt tokens held in cached segments.",
+            ).set_function(lambda: self.prefix_cache.tokens_cached)
+            reg.gauge(
+                "serve_prefix_capacity_tokens",
+                "Prefix-cache capacity in tokens (whole region slots).",
+            ).set_function(lambda: self.prefix_cache.capacity_tokens)
+            reg.gauge(
+                "serve_prefix_region_bytes",
+                "Device bytes of the prefix-cache segment region.",
+            ).set_function(lambda: self.prefix_cache.nbytes())
+
+    def _on_prefix_evict(self, seg) -> None:
+        self.metrics.record_prefix_eviction()
+        self.tracer.instant(
+            ENGINE_TRACK, "prefix_evict", length=seg.length,
+        )
 
     # -- compiled programs -------------------------------------------------
 
-    def _build_step(self):
+    def _step_fn_for(self, horizon: int):
+        """The compiled fused-step program for ``horizon`` substeps
+        (cached per K — the adaptive horizon alternates between the
+        configured K and 1)."""
+        fn = self._step_fns.get(horizon)
+        if fn is None:
+            fn = jax.jit(
+                self._build_step(horizon),
+                donate_argnums=self._state_donate,
+            )
+            self._step_fns[horizon] = fn
+        return fn
+
+    def _build_step(self, horizon: int):
         """K fused decode substeps in one program. The carry —
         caches, pending logits, positions, active mask, remaining
         budget — lives entirely on device; ``eos`` is per-slot data.
@@ -390,7 +508,6 @@ class ServingEngine:
         fwd1 = self._fwd1
         temperature, top_k = self.temperature, self.top_k
         approx_top_k = self.approx_top_k
-        horizon = self.decode_horizon
 
         def step(params, caches, logits, pos, active, budget, eos,
                  slot_keys_raw):
@@ -533,6 +650,191 @@ class ServingEngine:
             )
         return self._insert_fn
 
+    def _hit_insert(self):
+        """Jitted FULL-hit admission: one gather/dynamic-update program
+        that copies a segment's whole slab from the region into the
+        pool at the slot index, lands the segment's stored last-row
+        logits, and sets the slot's device state — zero prompt rows
+        computed, zero prefill dispatches."""
+        if self._hit_insert_fn is None:
+
+            def hit(caches, logits, pos, active, budget, eos, region,
+                    seg_lg, seg, slot, pos0, max_new, eos_tok):
+                slab = jax.tree.map(
+                    lambda r: lax.dynamic_slice(
+                        r, (0, 0, seg, 0, 0),
+                        (r.shape[0], r.shape[1], 1, r.shape[3],
+                         r.shape[4]),
+                    ),
+                    region,
+                )
+                caches = jax.tree.map(
+                    lambda c, t: lax.dynamic_update_slice(
+                        c, t, (0, 0, slot, 0, 0)
+                    ),
+                    caches, slab,
+                )
+                logits = lax.dynamic_update_slice(
+                    logits, seg_lg, (slot, 0)
+                )
+                pos = pos.at[slot].set(pos0)
+                active = active.at[slot].set(True)
+                budget = budget.at[slot].set(max_new)
+                eos = eos.at[slot].set(eos_tok)
+                return caches, logits, pos, active, budget, eos
+
+            # donates the pool state only — the region must survive
+            self._hit_insert_fn = jax.jit(
+                hit, donate_argnums=self._admit_donate
+            )
+        return self._hit_insert_fn
+
+    def _seg_fetch(self):
+        """Jitted segment fetch: one region slot's slab as a batch-1
+        scratch cache (the partial-hit path chunk-computes the suffix
+        on top of it)."""
+        if self._seg_fetch_fn is None:
+
+            def fetch(region, seg):
+                return jax.tree.map(
+                    lambda r: lax.dynamic_slice(
+                        r, (0, 0, seg, 0, 0),
+                        (r.shape[0], r.shape[1], 1, r.shape[3],
+                         r.shape[4]),
+                    ),
+                    region,
+                )
+
+            self._seg_fetch_fn = jax.jit(fetch)
+        return self._seg_fetch_fn
+
+    def _seg_store(self):
+        """Jitted segment store: copy a pool slot's slab into the
+        region at the segment index (insert-on-completion). Pool caches
+        are read, not donated; the region is replaced functionally."""
+        if self._seg_store_fn is None:
+            tpu = jax.devices()[0].platform == "tpu"
+
+            def store(region, caches, seg, slot):
+                slab = jax.tree.map(
+                    lambda c: lax.dynamic_slice(
+                        c, (0, 0, slot, 0, 0),
+                        (c.shape[0], c.shape[1], 1, c.shape[3],
+                         c.shape[4]),
+                    ),
+                    caches,
+                )
+                return jax.tree.map(
+                    lambda r, t: lax.dynamic_update_slice(
+                        r, t, (0, 0, seg, 0, 0)
+                    ),
+                    region, slab,
+                )
+
+            self._seg_store_fn = jax.jit(
+                store, donate_argnums=(0,) if tpu else ()
+            )
+        return self._seg_store_fn
+
+    def _logit_row(self):
+        """Jitted (1, V) row slice of the pending logits — captured at
+        insert time so a later FULL hit replays the exact prefill
+        logits without recomputing anything."""
+        if self._logit_row_fn is None:
+            self._logit_row_fn = jax.jit(
+                lambda lg, slot: lax.dynamic_slice(
+                    lg, (slot, 0), (1, lg.shape[1])
+                )
+            )
+        return self._logit_row_fn
+
+    def _batch_prefill_fn(self, bucket: int, nb: int):
+        """Jitted BATCHED admission prefill: ``nb`` same-bucket prompts
+        prefilled in one dispatched program (vector per-row last_idx),
+        each row's slab + logits + device state landed at its slot.
+        Group sizes are padded to powers of two (pad rows repeat row 0,
+        re-writing identical values), so the program count stays
+        O(buckets x log n_slots)."""
+        fn = self._batch_prefill_fns.get((bucket, nb))
+        if fn is None:
+            do_prefill = self._do_prefill
+            init_caches = self._init_caches
+            max_total = self.max_total
+
+            def bprefill(caches, logits, pos, active, budget, eos,
+                         params, prompts, last_idx, slots, pos0,
+                         max_new, eos_toks):
+                tmp, lg = do_prefill(
+                    params, init_caches(nb, max_total), prompts,
+                    last_idx=last_idx,
+                )
+                for r in range(nb):
+                    slab = jax.tree.map(
+                        lambda t, r=r: t[:, :, r:r + 1], tmp
+                    )
+                    caches = jax.tree.map(
+                        lambda c, t, r=r: lax.dynamic_update_slice(
+                            c, t, (0, 0, slots[r], 0, 0)
+                        ),
+                        caches, slab,
+                    )
+                    logits = lax.dynamic_update_slice(
+                        logits, lg[r:r + 1], (slots[r], 0)
+                    )
+                    pos = pos.at[slots[r]].set(pos0[r])
+                    active = active.at[slots[r]].set(True)
+                    budget = budget.at[slots[r]].set(max_new[r])
+                    eos = eos.at[slots[r]].set(eos_toks[r])
+                return caches, logits, pos, active, budget, eos
+
+            fn = jax.jit(bprefill, donate_argnums=self._admit_donate)
+            self._batch_prefill_fns[(bucket, nb)] = fn
+        return fn
+
+    def _batch_hit_fn(self, bucket: int, nb: int):
+        """Jitted BATCHED partial-hit admission for ``nb`` requests
+        sharing the same cached-prefix length L and suffix bucket: one
+        gather pulls each row's segment slab from the region, one
+        ``forward_chunk`` at scalar pos0=L (vector per-row last_idx)
+        computes all the uncached suffixes, and each row lands at its
+        slot. The common case — many requests behind one system
+        prompt — gathers the SAME segment nb times."""
+        fn = self._batch_hit_fns.get((bucket, nb))
+        if fn is None:
+            fwd_chunk = self._fwd_chunk
+
+            def bhit(caches, logits, pos, active, budget, eos, params,
+                     region, seg_idx, toks, p0, last_idx, slots, posf,
+                     max_new, eos_toks):
+                tmp = jax.tree.map(
+                    lambda r_: jnp.take(r_, seg_idx, axis=2), region
+                )
+                lg, tmp = fwd_chunk(
+                    params, tmp, toks, p0, last_idx=last_idx
+                )
+                for r in range(nb):
+                    slab = jax.tree.map(
+                        lambda t, r=r: t[:, :, r:r + 1], tmp
+                    )
+                    caches = jax.tree.map(
+                        lambda c, t, r=r: lax.dynamic_update_slice(
+                            c, t, (0, 0, slots[r], 0, 0)
+                        ),
+                        caches, slab,
+                    )
+                    logits = lax.dynamic_update_slice(
+                        logits, lg[r:r + 1], (slots[r], 0)
+                    )
+                    pos = pos.at[slots[r]].set(posf[r])
+                    active = active.at[slots[r]].set(True)
+                    budget = budget.at[slots[r]].set(max_new[r])
+                    eos = eos.at[slots[r]].set(eos_toks[r])
+                return caches, logits, pos, active, budget, eos
+
+            fn = jax.jit(bhit, donate_argnums=self._admit_donate)
+            self._batch_hit_fns[(bucket, nb)] = fn
+        return fn
+
     # -- bucketing ---------------------------------------------------------
 
     def _bucket_for(self, n: int) -> int:
@@ -543,17 +845,25 @@ class ServingEngine:
             b *= 2
         return b
 
-    def _chunk_schedule(self, n: int) -> list[tuple[int, int, int]]:
-        """(offset, real_len, bucket) chunks covering a long prompt's
-        rows 0..n-1 through the power-of-two bucket programs. Every
+    def _chunk_schedule(self, n: int, start: int = 0
+                        ) -> list[tuple[int, int, int]]:
+        """(offset, real_len, bucket) chunks covering a prompt's rows
+        start..n-1 through the power-of-two bucket programs. Every
         write window [offset, offset+bucket) must fit the pooled Tpad
         (a clamped ``dynamic_update_slice`` would SHIFT over real
         rows); when the padded tail would spill, the remainder is
         decomposed into exact power-of-two pieces plus one minimal
         padded tail, which always fits (pieces are sublane multiples,
-        Tpad is a sublane multiple)."""
+        Tpad is a sublane multiple). ``start`` > 0 is the partial-hit
+        suffix path — the first ``start`` rows came from a cached
+        segment; the caller grain-aligns it (start % _min_bucket == 0)
+        so the window-fit invariant carries over unchanged."""
+        if start % self._min_bucket:
+            raise AssertionError(
+                f"chunk start {start} not {self._min_bucket}-aligned"
+            )
         tpad = self.pool.tpad
-        sched, t0, rem = [], 0, n
+        sched, t0, rem = [], start, n - start
         while rem > self._max_bucket:
             sched.append((t0, self._max_bucket, self._max_bucket))
             t0 += self._max_bucket
@@ -676,6 +986,10 @@ class ServingEngine:
         else:
             self.metrics.record_outcome(status)
         self.pool.release(slot)
+        if self.prefix_cache is not None:
+            for seg in st.segs:
+                self.prefix_cache.unpin(seg)
+        st.segs = []
         self._slots[slot] = None
         if deactivate:
             self._dactive = self._deact_fn(self._dactive, jnp.int32(slot))
@@ -731,69 +1045,82 @@ class ServingEngine:
 
     # -- admission ---------------------------------------------------------
 
-    def _prefill_seq_into_slot(self, seq: np.ndarray, slot: int,
-                               budget: int, eos_tok: int) -> None:
-        """Land ``seq`` (prompt, or prompt+replayed tokens) in ``slot``
-        through the bucketed prefill path and set the slot's device
-        state: position len(seq), active, ``budget`` tokens remaining.
-        Dispatches O(1) programs for bucket-sized sequences and
-        O(len/bucket) on the chunked long-prompt path."""
+    def _prefill_into_state(self, state, seq: np.ndarray, slot: int,
+                            budget: int, eos_tok: int):
+        """Land ``seq`` in ``slot`` of a pool-shaped ``state`` tuple
+        through the bucketed prefill path and return the new state
+        (pure w.r.t. engine attributes — the parity probes run it on
+        scratch state). Dispatches O(1) programs for bucket-sized
+        sequences and O(len/bucket) on the chunked long-prompt path."""
         n = int(len(seq))
-        state = (self.pool.caches, self._logits, self._dpos,
-                 self._dactive, self._dbudget, self._deos)
         if n == 0:
             # empty prompt: decode starts from uniform logits over a
             # zeroed slab, as the unbucketed prefill did
             tmp = self._init_caches(1, self.max_total)
             lg = jnp.zeros((1, self.cfg.vocab_size), jnp.float32)
-            out = self._insert()(
+            return self._insert()(
                 *state, tmp, lg, jnp.int32(slot), jnp.int32(0),
                 jnp.int32(budget), jnp.int32(eos_tok),
             )
-        elif n <= self._max_bucket:
+        if n <= self._max_bucket:
             b = self._bucket_for(n)
             pad = np.zeros((1, b), np.int32)
             pad[0, :n] = seq
-            out = self._prefill_fn(b)(
+            self.prefill_dispatches += 1
+            return self._prefill_fn(b)(
                 *state, self.params, jnp.asarray(pad), jnp.int32(n - 1),
                 jnp.int32(slot), jnp.int32(n), jnp.int32(budget),
                 jnp.int32(eos_tok),
             )
-        else:
-            # chunked: walk the prompt through forward_chunk at bucket
-            # sizes over a batch-1 scratch cache, then one slab insert —
-            # a long admission compiles nothing new and never stalls
-            # the decode loop on a monster program
-            tmp = self._init_caches(1, self.max_total)
-            lg = None
-            for t0, ln, b in self._chunk_schedule(n):
-                pad = np.zeros((1, b), np.int32)
-                pad[0, :ln] = seq[t0:t0 + ln]
-                tmp, lg = self._chunk_fn(b)(
-                    self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
-                    jnp.int32(ln - 1),
-                )
-            out = self._insert()(
-                *state, tmp, lg, jnp.int32(slot), jnp.int32(n),
-                jnp.int32(budget), jnp.int32(eos_tok),
+        # chunked: walk the prompt through forward_chunk at bucket
+        # sizes over a batch-1 scratch cache, then one slab insert —
+        # a long admission compiles nothing new and never stalls
+        # the decode loop on a monster program
+        tmp = self._init_caches(1, self.max_total)
+        lg = None
+        for t0, ln, b in self._chunk_schedule(n):
+            pad = np.zeros((1, b), np.int32)
+            pad[0, :ln] = seq[t0:t0 + ln]
+            tmp, lg = self._chunk_fn(b)(
+                self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
+                jnp.int32(ln - 1),
             )
+            self.prefill_dispatches += 1
+        return self._insert()(
+            *state, tmp, lg, jnp.int32(slot), jnp.int32(n),
+            jnp.int32(budget), jnp.int32(eos_tok),
+        )
+
+    def _state(self):
+        return (self.pool.caches, self._logits, self._dpos,
+                self._dactive, self._dbudget, self._deos)
+
+    def _set_state(self, out) -> None:
         (self.pool.caches, self._logits, self._dpos, self._dactive,
          self._dbudget, self._deos) = out
 
-    def _prefill_with_retries(self, req: Request, slot: int) -> bool:
-        """Run the admission prefill under transient-retry supervision.
-        Returns False when the request is poisoned (caller fails it).
-        One fault check per ADMISSION (not per chunk), so scripted
-        chaos plans stay request-aligned."""
+    def _prefill_seq_into_slot(self, seq: np.ndarray, slot: int,
+                               budget: int, eos_tok: int) -> None:
+        """Land ``seq`` (prompt, or prompt+replayed tokens) in ``slot``
+        through the bucketed prefill path and set the slot's device
+        state: position len(seq), active, ``budget`` tokens
+        remaining."""
+        self._set_state(self._prefill_into_state(
+            self._state(), seq, slot, budget, eos_tok
+        ))
+
+    def _check_prefill_faults(self, req: Request) -> bool:
+        """The admission fault boundary under transient-retry
+        supervision — one check per ADMISSION (not per chunk or per
+        batch), so scripted chaos plans stay request-aligned. Returns
+        False when the request is poisoned (caller fails it);
+        ``EngineCrash`` propagates to the supervisor."""
+        if self.faults is None:
+            return True
         attempt, backoff = 0, self.retry_backoff_s
-        eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
         while True:
             try:
-                if self.faults is not None:
-                    self.faults.check("prefill", req_id=req.id)
-                self._prefill_seq_into_slot(
-                    req.prompt, slot, req.max_new, eos_tok
-                )
+                self.faults.check("prefill", req_id=req.id)
                 return True
             except TransientFault as e:
                 self.metrics.record_retry()
@@ -810,11 +1137,434 @@ class ServingEngine:
                 req.error = str(e)
                 return False
 
+    # -- admission parity probes -------------------------------------------
+
+    def _scratch_state(self):
+        """A pool-shaped device state tuple over freshly zeroed scratch
+        buffers. The parity probes run the PRODUCTION compiled programs
+        on it — so probing never touches live pool state (unlike the
+        recovery-time chunked-replay probe, which runs on abandoned
+        buffers) and compiles nothing the serving path won't reuse."""
+        return (
+            self._init_caches(self.n_slots, self.max_total),
+            jnp.zeros((self.n_slots, self.cfg.vocab_size), jnp.float32),
+            jnp.zeros((self.n_slots,), jnp.int32),
+            jnp.zeros((self.n_slots,), bool),
+            jnp.zeros((self.n_slots,), jnp.int32),
+            jnp.full((self.n_slots,), _NO_EOS, jnp.int32),
+        )
+
+    @staticmethod
+    def _states_equal(x, y) -> bool:
+        return all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(x), jax.tree.leaves(y))
+        )
+
+    @staticmethod
+    def _slot_rows(caches, slot: int, n: int):
+        return [np.asarray(leaf[:, :, slot, :n])
+                for leaf in jax.tree.leaves(caches)]
+
+    def _probe_prefix_parity(self) -> bool:
+        """One-time probe gating hit-path reuse (the admission-side
+        mirror of ``chunked_replay="auto"``): is copy-cached-prefix-
+        rows + chunk-computed suffix bitwise identical — KV rows AND
+        logits — to the full bucketed prefill? On backends where the
+        differently-scheduled programs agree only to float-
+        reassociation level, every lookup is treated as a miss and
+        admission falls back to full prefill."""
+        L = self._min_bucket
+        n = min(L + 3, self.max_total, self.pool.tpad)
+        if n <= L:
+            return False
+        _disp = self.prefill_dispatches  # probes don't count
+        try:
+            seq = ((1 + np.arange(n)) % self.cfg.vocab_size).astype(
+                np.int32
+            )
+            # miss path: the full bucketed prefill
+            sa = self._prefill_into_state(
+                self._scratch_state(), seq, 0, 1, _NO_EOS
+            )
+            rows_a = self._slot_rows(sa[0], 0, n)
+            lg_a = np.asarray(sa[1][0])
+            # build the segment exactly as insert-on-completion does
+            sb = self._prefill_into_state(
+                self._scratch_state(), seq[:L], 0, 1, _NO_EOS
+            )
+            region = self.pool.alloc_region(1)
+            region = self._seg_store()(
+                region, sb[0], jnp.int32(0), jnp.int32(0)
+            )
+            # hit path: fetch + suffix chunks + insert
+            tmp = self._seg_fetch()(region, jnp.int32(0))
+            lg = None
+            for t0, ln, b in self._chunk_schedule(n, start=L):
+                pad = np.zeros((1, b), np.int32)
+                pad[0, :ln] = seq[t0:t0 + ln]
+                tmp, lg = self._chunk_fn(b)(
+                    self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
+                    jnp.int32(ln - 1),
+                )
+            sc = self._insert()(
+                *self._scratch_state(), tmp, lg, jnp.int32(0),
+                jnp.int32(n), jnp.int32(1), jnp.int32(_NO_EOS),
+            )
+            rows_c = self._slot_rows(sc[0], 0, n)
+            lg_c = np.asarray(sc[1][0])
+            return bool(
+                np.array_equal(lg_a, lg_c)
+                and all(np.array_equal(a, c)
+                        for a, c in zip(rows_a, rows_c))
+            )
+        finally:
+            self.prefill_dispatches = _disp
+
+    def _probe_batch_parity(self) -> bool:
+        """One-time probe gating batched admission: do the batched
+        same-bucket prefill program (vector last_idx) and — when the
+        prefix cache reuses — the batched partial-hit program
+        reproduce, bitwise, the full device state the serial
+        per-request paths produce?"""
+        if self.n_slots < 2:
+            return False
+        n0 = min(self._min_bucket, self.max_total)
+        if n0 < 2:
+            return False
+        n1 = n0 - 1
+        b = self._bucket_for(n0)
+        _disp = self.prefill_dispatches  # probes don't count
+        try:
+            vs = self.cfg.vocab_size
+            seq0 = ((1 + np.arange(n0)) % vs).astype(np.int32)
+            seq1 = ((2 + np.arange(n1)) % vs).astype(np.int32)
+            sa = self._prefill_into_state(
+                self._scratch_state(), seq0, 0, 3, _NO_EOS
+            )
+            sa = self._prefill_into_state(sa, seq1, 1, 2, _NO_EOS)
+            prompts = np.zeros((2, b), np.int32)
+            prompts[0, :n0] = seq0
+            prompts[1, :n1] = seq1
+            sb = self._batch_prefill_fn(b, 2)(
+                *self._scratch_state(), self.params,
+                jnp.asarray(prompts),
+                jnp.asarray([n0 - 1, n1 - 1], np.int32),
+                jnp.asarray([0, 1], np.int32),
+                jnp.asarray([n0, n1], np.int32),
+                jnp.asarray([3, 2], np.int32),
+                jnp.asarray([_NO_EOS, _NO_EOS], np.int32),
+            )
+            if not self._states_equal(sa, sb):
+                return False
+            if self.prefix_cache is None or not self._prefix_reuse_ok():
+                return True
+            # batched partial hits: two suffixes behind one cached
+            # prefix, serial fetch+chunk+insert vs one batched program
+            L = self._min_bucket
+            lns = (2, 1)
+            bs = self._bucket_for(max(lns))
+            if (L + max(lns) > self.max_total
+                    or L + bs > self.pool.tpad):
+                return True  # geometry can't form hit groups anyway
+            prefix = ((3 + np.arange(L)) % vs).astype(np.int32)
+            sfx = [((5 + r + np.arange(ln)) % vs).astype(np.int32)
+                   for r, ln in enumerate(lns)]
+            sp = self._prefill_into_state(
+                self._scratch_state(), prefix, 0, 1, _NO_EOS
+            )
+            region = self.pool.alloc_region(1)
+            region = self._seg_store()(
+                region, sp[0], jnp.int32(0), jnp.int32(0)
+            )
+            sh = self._scratch_state()
+            for r, ln in enumerate(lns):
+                tmp = self._seg_fetch()(region, jnp.int32(0))
+                pad = np.zeros((1, bs), np.int32)
+                pad[0, :ln] = sfx[r]
+                tmp, lg = self._chunk_fn(bs)(
+                    self.params, tmp, jnp.asarray(pad), jnp.int32(L),
+                    jnp.int32(ln - 1),
+                )
+                sh = self._insert()(
+                    *sh, tmp, lg, jnp.int32(r), jnp.int32(L + ln),
+                    jnp.int32(2), jnp.int32(_NO_EOS),
+                )
+            toks = np.zeros((2, bs), np.int32)
+            for r, ln in enumerate(lns):
+                toks[r, :ln] = sfx[r]
+            sbh = self._batch_hit_fn(bs, 2)(
+                *self._scratch_state(), self.params, region,
+                jnp.asarray([0, 0], np.int32), jnp.asarray(toks),
+                jnp.int32(L),
+                jnp.asarray([ln - 1 for ln in lns], np.int32),
+                jnp.asarray([0, 1], np.int32),
+                jnp.asarray([L + ln for ln in lns], np.int32),
+                jnp.asarray([2, 2], np.int32),
+                jnp.asarray([_NO_EOS, _NO_EOS], np.int32),
+            )
+            return self._states_equal(sh, sbh)
+        finally:
+            self.prefill_dispatches = _disp
+
+    def _prefix_reuse_ok(self) -> bool:
+        if self.prefix_cache is None:
+            return False
+        if self._prefix_ok_memo is None:
+            self._prefix_ok_memo = self._probe_prefix_parity()
+            log_event(_log, "prefix_parity_probe",
+                      ok=self._prefix_ok_memo)
+            self.tracer.instant(ENGINE_TRACK, "prefix_parity_probe",
+                                ok=self._prefix_ok_memo)
+        return self._prefix_ok_memo
+
+    def _batch_admission_ok(self) -> bool:
+        if self.batch_admission is True:
+            return True
+        if self.batch_admission is False:
+            return False
+        if self._batch_ok_memo is None:
+            self._batch_ok_memo = self._probe_batch_parity()
+            log_event(_log, "batch_parity_probe",
+                      ok=self._batch_ok_memo)
+            self.tracer.instant(ENGINE_TRACK, "batch_parity_probe",
+                                ok=self._batch_ok_memo)
+        return self._batch_ok_memo
+
+    def _classify_plan(self, pl: _AdmitPlan) -> None:
+        """Prefix-cache lookup for one planned admission. A FULL hit
+        (whole prompt cached, stored logits present) admits by pure
+        copy; a PARTIAL hit reuses the longest cached prefix rounded
+        DOWN to the bucket grain (suffix chunk windows must start
+        sublane-aligned to provably fit Tpad) and chunk-computes only
+        the suffix. The source segment is pinned here and unpinned at
+        retirement, so eviction can never drop a segment an active
+        slot's admission read."""
+        cache = self.prefix_cache
+        n = len(pl.req.prompt)
+        if (cache is None or n == 0 or not self._prefix_reuse_ok()):
+            return
+        seg, m = cache.lookup(pl.req.prompt)
+        if seg is None:
+            self.metrics.record_prefix_lookup("miss", 0)
+            return
+        if m == n and seg.logits is not None:
+            pl.kind, pl.seg, pl.matched = "full", seg, n
+        else:
+            L = min(m, n - 1)
+            L -= L % self._min_bucket
+            if L <= 0:
+                self.metrics.record_prefix_lookup("miss", 0)
+                return
+            pl.kind, pl.seg, pl.matched = "partial", seg, L
+        cache.pin(seg)
+        self.metrics.record_prefix_lookup(
+            "hit_full" if pl.kind == "full" else "hit_partial",
+            pl.matched,
+        )
+        self.tracer.instant(
+            slot_track(pl.slot), "prefix_hit", req_id=pl.req.id,
+            kind=pl.kind, cached_tokens=pl.matched, prompt_len=n,
+        )
+
+    def _admit_full_hit(self, pl: _AdmitPlan) -> None:
+        """Admission by pure device copy: segment slab + stored logits.
+        Dispatches ZERO prefill programs for the cached portion — which
+        is all of it."""
+        req = pl.req
+        eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
+        self._set_state(self._hit_insert()(
+            *self._state(), self.prefix_cache.region, pl.seg.logits,
+            jnp.int32(pl.seg.slot), jnp.int32(pl.slot),
+            jnp.int32(len(req.prompt)), jnp.int32(req.max_new),
+            jnp.int32(eos_tok),
+        ))
+
+    def _admit_partial_hit(self, pl: _AdmitPlan) -> None:
+        """Serial partial-hit assembly: fetch the segment slab as the
+        scratch cache, chunk-compute rows [matched, n) through the same
+        bucket programs the long-prompt path uses, then one slab
+        insert. Only the uncached suffix costs prefill dispatches."""
+        req = pl.req
+        seq, n, L = req.prompt, len(req.prompt), pl.matched
+        eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
+        tmp = self._seg_fetch()(
+            self.prefix_cache.region, jnp.int32(pl.seg.slot)
+        )
+        lg = None
+        for t0, ln, b in self._chunk_schedule(n, start=L):
+            pad = np.zeros((1, b), np.int32)
+            pad[0, :ln] = seq[t0:t0 + ln]
+            tmp, lg = self._chunk_fn(b)(
+                self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
+                jnp.int32(ln - 1),
+            )
+            self.prefill_dispatches += 1
+        self._set_state(self._insert()(
+            *self._state(), tmp, lg, jnp.int32(pl.slot), jnp.int32(n),
+            jnp.int32(req.max_new), jnp.int32(eos_tok),
+        ))
+
+    @staticmethod
+    def _pad_group(group: list, nb: int) -> list:
+        """Pad a batched-admission group to ``nb`` rows by repeating
+        the first plan — the duplicate rows recompute identical values
+        and re-write them to the same slot, so the result is unchanged
+        while the compiled-program count stays at powers of two."""
+        return group + [group[0]] * (nb - len(group))
+
+    def _batch_prefill_group(self, bucket: int,
+                             group: list[_AdmitPlan]) -> None:
+        """One dispatched program admits every plan in ``group`` (all
+        misses padding to the same bucket)."""
+        nb = 1
+        while nb < len(group):
+            nb *= 2
+        rows = self._pad_group(group, nb)
+        prompts = np.zeros((nb, bucket), np.int32)
+        last_idx = np.zeros((nb,), np.int32)
+        slots = np.zeros((nb,), np.int32)
+        pos0 = np.zeros((nb,), np.int32)
+        max_new = np.zeros((nb,), np.int32)
+        eos_toks = np.full((nb,), _NO_EOS, np.int32)
+        for r, pl in enumerate(rows):
+            n = len(pl.req.prompt)
+            prompts[r, :n] = pl.req.prompt
+            last_idx[r] = n - 1
+            slots[r] = pl.slot
+            pos0[r] = n
+            max_new[r] = pl.req.max_new
+            if pl.req.eos_token is not None:
+                eos_toks[r] = int(pl.req.eos_token)
+        self.prefill_dispatches += 1
+        self._set_state(self._batch_prefill_fn(bucket, nb)(
+            *self._state(), self.params, jnp.asarray(prompts),
+            jnp.asarray(last_idx), jnp.asarray(slots),
+            jnp.asarray(pos0), jnp.asarray(max_new),
+            jnp.asarray(eos_toks),
+        ))
+        self.metrics.record_batched_admissions(len(group))
+
+    def _batch_hit_group(self, bucket: int, L: int,
+                         group: list[_AdmitPlan]) -> None:
+        """One dispatched program admits every plan in ``group`` (all
+        partial hits with cached length L and a single suffix window of
+        the same bucket)."""
+        nb = 1
+        while nb < len(group):
+            nb *= 2
+        rows = self._pad_group(group, nb)
+        seg_idx = np.zeros((nb,), np.int32)
+        toks = np.zeros((nb, bucket), np.int32)
+        last_idx = np.zeros((nb,), np.int32)
+        slots = np.zeros((nb,), np.int32)
+        posf = np.zeros((nb,), np.int32)
+        max_new = np.zeros((nb,), np.int32)
+        eos_toks = np.full((nb,), _NO_EOS, np.int32)
+        for r, pl in enumerate(rows):
+            n = len(pl.req.prompt)
+            ln = n - L
+            seg_idx[r] = pl.seg.slot
+            toks[r, :ln] = pl.req.prompt[L:]
+            last_idx[r] = ln - 1
+            slots[r] = pl.slot
+            posf[r] = n
+            max_new[r] = pl.req.max_new
+            if pl.req.eos_token is not None:
+                eos_toks[r] = int(pl.req.eos_token)
+        self.prefill_dispatches += 1
+        self._set_state(self._batch_hit_fn(bucket, nb)(
+            *self._state(), self.params, self.prefix_cache.region,
+            jnp.asarray(seg_idx), jnp.asarray(toks), jnp.int32(L),
+            jnp.asarray(last_idx), jnp.asarray(slots),
+            jnp.asarray(posf), jnp.asarray(max_new),
+            jnp.asarray(eos_toks),
+        ))
+        self.metrics.record_batched_admissions(len(group))
+
+    def _seat_plan(self, pl: _AdmitPlan, now: float) -> None:
+        """Host bookkeeping that makes an executed plan a live slot:
+        sampling key split (in admission order — the order replay
+        reproduces), slot state, metrics, spans."""
+        req, slot = pl.req, pl.slot
+        self._key, sub = jax.random.split(self._key)
+        kd = np.asarray(jax.random.key_data(sub))
+        self._slot_keys[slot] = kd
+        st = _SlotState(req, self.pool.generation(slot), kd)
+        if pl.seg is not None:
+            st.segs.append(pl.seg)
+        self._slots[slot] = st
+        pl.admitted = True
+        req.status = RequestStatus.RUNNING
+        self.metrics.record_prefill(req.id, pl.prefill_s)
+        delay = (time.perf_counter() - req.arrival_time
+                 if req.arrival_time is not None else None)
+        if delay is not None:
+            self.metrics.record_admitted(req.id, delay)
+            self.tracer.span(
+                SCHEDULER_TRACK, "queued", req.arrival_time,
+                delay, req_id=req.id,
+            )
+        self.tracer.span(
+            slot_track(slot), "prefill", pl.t_pf, pl.prefill_s,
+            req_id=req.id, prompt_len=len(req.prompt),
+            prefix=pl.kind, cached_tokens=pl.matched,
+        )
+        log_event(_log, "request_admitted", req_id=req.id,
+                  slot=slot, prompt_len=len(req.prompt),
+                  queue_delay_s=delay,
+                  prefill_s=round(pl.prefill_s, 6),
+                  prefix=pl.kind, cached_tokens=pl.matched)
+
+    def _maybe_insert_prefix(self, pl: _AdmitPlan) -> None:
+        """Insert-on-completion (of the prefill): cache the admitted
+        prompt's full KV as a new segment — one slab copy into the
+        region plus the (1, V) logits row, both captured before any
+        decode step touches the slot. ``insert`` may return a second
+        segment at a newly observed branch point (two prompts seen
+        diverging there — the system-prompt sharing signal); it gets
+        the same slab copy but NO logits row (no request ended at that
+        length, so it only ever serves partial hits). The creating
+        request pins every segment until retirement."""
+        cache = self.prefix_cache
+        n = len(pl.req.prompt)
+        if (cache is None or pl.kind == "full"
+                or n < self._min_bucket or not self._prefix_reuse_ok()):
+            return
+        for seg in cache.insert(pl.req.prompt):
+            cache.region = self._seg_store()(
+                cache.region, self.pool.caches, jnp.int32(seg.slot),
+                jnp.int32(pl.slot),
+            )
+            if seg.length == n:
+                seg.logits = self._logit_row()(
+                    self._logits, jnp.int32(pl.slot))
+            self._slots[pl.slot].segs.append(seg)
+            self.metrics.record_prefix_insert()
+            self.tracer.instant(
+                ENGINE_TRACK, "prefix_insert", req_id=pl.req.id,
+                length=seg.length,
+            )
+
     def _admit(self, now: float) -> None:
-        while self.pool.n_free and len(self.scheduler):
-            self._admitting += 1
-            try:
-                req = self.scheduler.pop()
+        """Admission at a horizon boundary: pop every admissible
+        request (one per free slot), classify each against the prefix
+        cache, then execute — misses that pad to the same bucket
+        coalesce into ONE dispatched prefill program, partial hits
+        sharing (bucket, cached length) coalesce the same way, full
+        hits admit by pure copy — and finally seat slot states in
+        admission order. A crash mid-batch requeues every plan that was
+        not yet seated (front of its class, original order) and
+        releases its slot/segment pins before the supervisor rebuilds
+        state."""
+        if not (self.pool.n_free and len(self.scheduler)):
+            return
+        self._admitting += 1
+        plans: list[_AdmitPlan] = []
+        try:
+            hint = None
+            while self.pool.n_free and len(self.scheduler):
+                req = self.scheduler.pop(affinity_hint=hint)
                 if req is None:
                     break
                 if req.cancelled:
@@ -823,53 +1573,104 @@ class ServingEngine:
                 if req.expired(now):
                     self._retire_unadmitted(req, RequestStatus.EXPIRED)
                     continue
-                slot = self.pool.acquire()
-                t_pf = time.perf_counter()
-                try:
-                    ok = self._prefill_with_retries(req, slot)
-                except BaseException:
-                    # EngineCrash (or anything unexpected) between pop
-                    # and admission: the request must not be dropped —
-                    # put it back at the front of its class before the
-                    # supervisor rebuilds state.
-                    self.pool.release(slot)
-                    self.scheduler.requeue(req)
-                    raise
-                t_adm = time.perf_counter()
-                self.metrics.record_prefill(req.id, t_adm - t_pf)
-                if not ok:
-                    self.pool.release(slot)
-                    self._retire_unadmitted(
-                        req, RequestStatus.FAILED, req.error
-                    )
-                    continue
-                # split the slot's sampling key here (deterministic by
-                # admission order — the same order replay reproduces)
-                self._key, sub = jax.random.split(self._key)
-                kd = np.asarray(jax.random.key_data(sub))
-                self._slot_keys[slot] = kd
-                self._slots[slot] = _SlotState(
-                    req, self.pool.generation(slot), kd
+                plans.append(_AdmitPlan(req, self.pool.acquire()))
+                hint = req.prompt
+            if not plans:
+                return
+            for pl in plans:
+                self._classify_plan(pl)
+            self._execute_plans(plans, now)
+        except BaseException:
+            # EngineCrash (or anything unexpected) mid-batch: no popped
+            # request may be dropped — requeue every unseated plan at
+            # the front of its class (reversed, so original order is
+            # restored) before the supervisor rebuilds state.
+            for pl in reversed(plans):
+                if not pl.admitted:
+                    if pl.seg is not None:
+                        self.prefix_cache.unpin(pl.seg)
+                    self.pool.release(pl.slot)
+                    self.scheduler.requeue(pl.req)
+            raise
+        finally:
+            self._admitting -= 1
+
+    def _execute_plans(self, plans: list[_AdmitPlan],
+                       now: float) -> None:
+        # fault boundary first, in admission order, so scripted chaos
+        # fires at the same per-request check counts as serial
+        # admission did
+        live: list[_AdmitPlan] = []
+        for pl in plans:
+            if self._check_prefill_faults(pl.req):
+                live.append(pl)
+            else:
+                if pl.seg is not None:
+                    self.prefix_cache.unpin(pl.seg)
+                    pl.seg = None
+                self.pool.release(pl.slot)
+                pl.admitted = True  # handled: excluded from requeue
+                self._retire_unadmitted(
+                    pl.req, RequestStatus.FAILED, pl.req.error
                 )
-                req.status = RequestStatus.RUNNING
-                delay = (time.perf_counter() - req.arrival_time
-                         if req.arrival_time is not None else None)
-                if delay is not None:
-                    self.metrics.record_admitted(req.id, delay)
-                    self.tracer.span(
-                        SCHEDULER_TRACK, "queued", req.arrival_time,
-                        delay, req_id=req.id,
-                    )
-                self.tracer.span(
-                    slot_track(slot), "prefill", t_pf, t_adm - t_pf,
-                    req_id=req.id, prompt_len=len(req.prompt),
+        # group what can share a dispatch
+        batch_ok = len(live) > 1 and self._batch_admission_ok()
+        miss_groups: dict[int, list[_AdmitPlan]] = {}
+        hit_groups: dict[tuple[int, int], list[_AdmitPlan]] = {}
+        if batch_ok:
+            for pl in live:
+                n = len(pl.req.prompt)
+                if pl.kind == "miss" and 0 < n <= self._max_bucket:
+                    miss_groups.setdefault(
+                        self._bucket_for(n), []
+                    ).append(pl)
+                elif pl.kind == "partial":
+                    sfx = n - pl.matched
+                    if sfx <= self._max_bucket:
+                        b = self._bucket_for(sfx)
+                        if pl.matched + b <= self.pool.tpad:
+                            hit_groups.setdefault(
+                                (b, pl.matched), []
+                            ).append(pl)
+        batched: set[int] = set()
+        for bucket, group in sorted(miss_groups.items()):
+            if len(group) >= 2:
+                t0 = time.perf_counter()
+                self._batch_prefill_group(bucket, group)
+                dt = (time.perf_counter() - t0) / len(group)
+                for pl in group:
+                    pl.t_pf, pl.prefill_s = t0, dt
+                    batched.add(id(pl))
+        for (bucket, length), group in sorted(hit_groups.items()):
+            if len(group) >= 2:
+                t0 = time.perf_counter()
+                self._batch_hit_group(bucket, length, group)
+                dt = (time.perf_counter() - t0) / len(group)
+                for pl in group:
+                    pl.t_pf, pl.prefill_s = t0, dt
+                    batched.add(id(pl))
+        # serial remainder, in admission order
+        for pl in live:
+            if id(pl) in batched:
+                continue
+            t0 = time.perf_counter()
+            if pl.kind == "full":
+                self._admit_full_hit(pl)
+            elif pl.kind == "partial":
+                self._admit_partial_hit(pl)
+            else:
+                eos_tok = (_NO_EOS if pl.req.eos_token is None
+                           else int(pl.req.eos_token))
+                self._prefill_seq_into_slot(
+                    pl.req.prompt, pl.slot, pl.req.max_new, eos_tok
                 )
-                log_event(_log, "request_admitted", req_id=req.id,
-                          slot=slot, prompt_len=len(req.prompt),
-                          queue_delay_s=delay,
-                          prefill_s=round(t_adm - t_pf, 6))
-            finally:
-                self._admitting -= 1
+            pl.t_pf, pl.prefill_s = t0, time.perf_counter() - t0
+        # seat states in admission order (sampling-key split order is
+        # part of the determinism contract), then cache new prefixes
+        for pl in live:
+            self._seat_plan(pl, now)
+        for pl in live:
+            self._maybe_insert_prefix(pl)
 
     # -- supervised dispatch + pipelined readback --------------------------
 
@@ -882,6 +1683,15 @@ class ServingEngine:
         nothing to dispatch (or quarantining emptied the batch)."""
         if not any(st is not None for st in self._slots):
             return None
+        # adaptive horizon: when requests are waiting for a slot, drop
+        # to K=1 so the next admission boundary arrives one substep
+        # away; restore the configured K once the queue drains. Byte-
+        # safe — the device stopping rule is applied per-substep, so
+        # the emitted stream is invariant to K.
+        k = (1 if (self.adaptive_horizon and len(self.scheduler) > 0)
+             else self.decode_horizon)
+        self.decode_horizon_current = k
+        step_fn = self._step_fn_for(k)
         attempt, backoff = 0, self.retry_backoff_s
         t_call = time.perf_counter()
         while True:
@@ -893,7 +1703,7 @@ class ServingEngine:
                 # concurrent admission writing a slot key must not race
                 # the in-flight step
                 (self.pool.caches, self._logits, self._dpos,
-                 self._dactive, self._dbudget, toks) = self._step_fn(
+                 self._dactive, self._dbudget, toks) = step_fn(
                     self.params, self.pool.caches, self._logits,
                     self._dpos, self._dactive, self._dbudget,
                     self._deos, jnp.asarray(self._slot_keys.copy()),
@@ -1059,6 +1869,13 @@ class ServingEngine:
         k = length - 2
         if k < 1:
             return False
+        _disp = self.prefill_dispatches  # probes don't count
+        try:
+            return self._probe_chunked_parity_inner(length, k)
+        finally:
+            self.prefill_dispatches = _disp
+
+    def _probe_chunked_parity_inner(self, length: int, k: int) -> bool:
         seq = ((1 + np.arange(length)) % self.cfg.vocab_size).astype(
             np.int32
         )
@@ -1118,6 +1935,17 @@ class ServingEngine:
         chunked = bool(live) and self._use_chunked_replay()
         self.pool.reinit()
         self._reset_device_state()
+        if self.prefix_cache is not None:
+            # the region shares the crash's blast radius (donated
+            # programs may have invalidated it mid-flight): drop every
+            # segment and re-create it zeroed. Replay then misses on
+            # every lookup — i.e. it replays through the same lookup
+            # path and takes the cold branch, byte-identical to a
+            # cold-start replay.
+            self.prefix_cache.reinit()
+            for st in self._slots:
+                if st is not None:
+                    st.segs = []
         # re-seat each live slot's sampling key from its host record —
         # with position-indexed fold_in sampling this is all it takes
         # for a temperature>0 stream to resume exactly where it left off

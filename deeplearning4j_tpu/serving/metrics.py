@@ -109,6 +109,17 @@ class ServingMetrics:
         self.n_cancelled = 0
         self.n_expired = 0
         self.n_backpressure = 0
+        # prefix-cache counters (see serving.prefix_cache): lookups by
+        # outcome, prompt tokens whose prefill was skipped because their
+        # KV came from a cached segment, segments inserted/evicted
+        self.n_prefix_hits_full = 0
+        self.n_prefix_hits_partial = 0
+        self.n_prefix_misses = 0
+        self.prefix_tokens_saved = 0
+        self.n_prefix_inserts = 0
+        self.n_prefix_evictions = 0
+        # admissions coalesced into shared same-bucket prefill dispatches
+        self.n_batched_admissions = 0
         self._step = 0
 
         # Prometheus instruments (get-or-create: a shared registry can
@@ -146,6 +157,27 @@ class ServingMetrics:
             "serve_phase_seconds",
             "Per-event wall seconds by request phase "
             "(queue|prefill|decode|sync).", ("phase",),
+        )
+        self._c_prefix_lookups = reg.counter(
+            "serve_prefix_lookups_total",
+            "Prefix-cache lookups by outcome "
+            "(hit_full|hit_partial|miss).", ("result",),
+        )
+        self._c_prefix_saved = reg.counter(
+            "serve_prefix_tokens_saved_total",
+            "Prompt tokens served from cached KV instead of prefill.",
+        )
+        self._c_prefix_inserts = reg.counter(
+            "serve_prefix_inserts_total", "Prefix segments cached.",
+        )
+        self._c_prefix_evictions = reg.counter(
+            "serve_prefix_evictions_total",
+            "Prefix segments evicted (LRU, never pinned ones).",
+        )
+        self._c_batched = reg.counter(
+            "serve_prefill_batched_total",
+            "Admissions coalesced into shared same-bucket prefill "
+            "dispatches.",
         )
 
     def _emit(self, tag: str, value: float, step: int | None = None) -> None:
@@ -230,6 +262,40 @@ class ServingMetrics:
         self.n_backpressure += 1
         self._c_backpressure.inc()
 
+    def record_prefix_lookup(self, result: str, saved_tokens: int) -> None:
+        """One admission-time prefix-cache lookup. ``result`` is
+        ``hit_full``/``hit_partial``/``miss``; ``saved_tokens`` is how
+        many prompt tokens the hit served from cached KV (the usable,
+        grain-aligned match — 0 on a miss)."""
+        self._c_prefix_lookups.inc(result=result)
+        if result == "hit_full":
+            self.n_prefix_hits_full += 1
+        elif result == "hit_partial":
+            self.n_prefix_hits_partial += 1
+        else:
+            self.n_prefix_misses += 1
+        if saved_tokens:
+            self.prefix_tokens_saved += int(saved_tokens)
+            self._c_prefix_saved.inc(int(saved_tokens))
+            self._emit("prefix_tokens_saved_total",
+                       self.prefix_tokens_saved)
+
+    def record_prefix_insert(self) -> None:
+        """One new segment cached."""
+        self.n_prefix_inserts += 1
+        self._c_prefix_inserts.inc()
+
+    def record_prefix_eviction(self) -> None:
+        """One unpinned segment dropped by LRU pressure."""
+        self.n_prefix_evictions += 1
+        self._c_prefix_evictions.inc()
+
+    def record_batched_admissions(self, n: int) -> None:
+        """``n`` admissions served by ONE shared prefill dispatch
+        (recorded once per coalesced group, n >= 2)."""
+        self.n_batched_admissions += int(n)
+        self._c_batched.inc(int(n))
+
     def record_outcome(self, status) -> None:
         """Non-FINISHED terminal outcome (status is a
         ``RequestStatus`` or its string value)."""
@@ -264,6 +330,19 @@ class ServingMetrics:
             "steps": self._step,
             "decode_horizon": self.decode_horizon,
         }
+        lookups = (self.n_prefix_hits_full + self.n_prefix_hits_partial
+                   + self.n_prefix_misses)
+        if lookups:
+            out["prefix_lookups"] = lookups
+            out["prefix_hit_rate"] = (
+                (self.n_prefix_hits_full + self.n_prefix_hits_partial)
+                / lookups
+            )
+            out["prefix_tokens_saved"] = self.prefix_tokens_saved
+            out["prefix_inserts"] = self.n_prefix_inserts
+            out["prefix_evictions"] = self.n_prefix_evictions
+        if self.n_batched_admissions:
+            out["batched_admissions"] = self.n_batched_admissions
         for name, xs in [("ttft", self.ttft), ("tpot", self.tpot),
                          ("queue_delay", self.queue_delay)]:
             if xs:

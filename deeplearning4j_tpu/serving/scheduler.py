@@ -125,9 +125,17 @@ class RequestScheduler:
         max_queue_depth: int = 128,
         max_total_tokens: int | None = None,
         n_priorities: int = 3,
+        prefix_affinity_tokens: int = 0,
     ):
         self.max_queue_depth = max_queue_depth
         self.max_total_tokens = max_total_tokens
+        # > 0 enables prefix-affinity ordering: ``pop`` may promote a
+        # queued request whose first ``prefix_affinity_tokens`` prompt
+        # tokens match the caller's hint (the previously admitted
+        # prompt), so same-prefix requests land in the same admission
+        # batch and the prefix cache gets back-to-back hits. Promotion
+        # stays within one priority class — strict priority still wins.
+        self.prefix_affinity_tokens = prefix_affinity_tokens
         self._queues = [deque() for _ in range(n_priorities)]
         self._lock = threading.Lock()
 
@@ -195,10 +203,31 @@ class RequestScheduler:
                         n += 1
         return n
 
-    def pop(self) -> Request | None:
-        """Highest-priority, oldest request — or None when idle."""
+    def pop(self, affinity_hint: np.ndarray | None = None
+            ) -> Request | None:
+        """Highest-priority, oldest request — or None when idle.
+
+        With ``prefix_affinity_tokens`` > 0 and an ``affinity_hint``
+        (the prompt just admitted), the front non-empty class is
+        scanned for the OLDEST request sharing the hint's first k
+        tokens and that one is promoted; otherwise plain FIFO. The scan
+        is bounded by the queue depth cap, and affinity never crosses a
+        priority boundary, so strict priority and within-class fairness
+        for non-matching requests are preserved (a matching request
+        only ever moves EARLIER)."""
+        k = self.prefix_affinity_tokens
         with self._lock:
             for q in self._queues:
-                if q:
-                    return q.popleft()
+                if not q:
+                    continue
+                if (k > 0 and affinity_hint is not None
+                        and len(affinity_hint) >= k):
+                    key = tuple(int(t) for t in affinity_hint[:k])
+                    for i, req in enumerate(q):
+                        if (len(req.prompt) >= k
+                                and tuple(int(t) for t in req.prompt[:k])
+                                == key):
+                            del q[i]
+                            return req
+                return q.popleft()
         return None

@@ -362,6 +362,8 @@ class ServingServer:
             and not self._engine_dead.is_set(),
             last_error=self._last_error,
         )
+        if eng.prefix_cache is not None:
+            out["prefix_cache"] = eng.prefix_cache.stats()
         return out
 
     def _engine_loop(self) -> None:
