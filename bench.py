@@ -72,6 +72,14 @@ per workload — the driver's round record captures all of them:
                   served through the radix-tree prefix cache: headlines
                   TTFT p50 and prefill-tokens-saved, with the
                   cache-off replay in-row pricing what reuse buys
+- ``transformer-decode-serve-tp`` the serve trace at a fixed global
+                  batch with the fused decode program + KV pool sharded
+                  over TP in {1,2,4,8} devices: headlines per-chip
+                  tok/s and scaling efficiency vs TP=1
+- ``transformer-decode-serve-router`` two full serving replicas behind
+                  the prefix-affinity router at 0.5 shared-prefix
+                  traffic, driven over real HTTP: headlines routed
+                  TTFT p50 speedup vs round-robin dispatch
 
 ``--model X`` runs a single workload. ``--scaling`` reports 1->N-chip
 data-parallel efficiency (lenet/alexnet); ``--profile DIR`` captures an
@@ -1010,6 +1018,291 @@ def _bench_decode_serve_prefix(args, n_slots: int = 16,
     return tok_per_sec, metric, extra
 
 
+def _bench_decode_serve_tp(args, n_slots: int = 16, n_requests: int = 32,
+                           mean_interarrival_s: float = 0.01):
+    """Tensor-parallel serving scaling: the serve trace replayed at a
+    FIXED global batch (same slots, same offered load, same streams)
+    while the fused decode program and the KV slot pool shard over
+    TP in {1, 2, 4, 8} devices. Reported per point: aggregate tok/s,
+    tok/s PER CHIP, and scaling efficiency tps(N) / (N * tps(1)) — the
+    honest number for weak-scaling-free sharding, since a fixed batch
+    gives TP=N no extra work to amortize its collectives. The headline
+    metric value is the widest point's per-chip rate.
+
+    Geometry: MHA with n_heads=8 (d_head=96) instead of the flagship's
+    6x128, because exact-TP sharding needs every swept width to divide
+    the head count; the metric name is versioned ``h96tp`` so this
+    row's history never mixes with the h128 rows. ``decode_kernel`` is
+    off at EVERY width (TP forces the dense path — the Pallas decode
+    kernel cannot GSPMD-partition — so TP=1 runs it too, keeping the
+    efficiency ratio a sharding measurement, not kernel-vs-dense).
+    Points whose width exceeds the host's device count (or fails the
+    construction-time bitwise parity probe) are reported as skipped.
+    Byte-parity of TP streams is pinned by tests/test_serving_tp.py —
+    this row only prices the sharding."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from deeplearning4j_tpu.serving import (
+        Request,
+        RequestScheduler,
+        ServingEngine,
+        ServingMetrics,
+        run_request_trace,
+    )
+
+    p = _TRANSFORMER_PRESETS["transformer"]
+    cfg = TransformerConfig(
+        vocab_size=p["vocab"], d_model=p["d_model"], n_heads=8,
+        n_layers=p["n_layers"], d_ff=p["d_ff"],
+        max_len=_DECODE_PROMPT_LEN + _DECODE_NEW + 1,
+        use_flash=False, decode_kernel=False,
+        compute_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    prompts = rng.integers(
+        0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
+    ).astype(np.int32)
+
+    def make_trace():
+        return [
+            (float(arrivals[i]),
+             Request(prompt=prompts[i], max_new=_DECODE_NEW))
+            for i in range(n_requests)
+        ]
+
+    def point(tp):
+        engine = ServingEngine(
+            cfg, params, n_slots=n_slots,
+            temperature=1.0, top_k=40,
+            approx_top_k=not args.exact_top_k,
+            decode_horizon=4,
+            scheduler=RequestScheduler(max_queue_depth=n_requests),
+            tp=tp,
+        )
+        if engine.tp != tp:
+            return None  # parity probe fell back: report as skipped
+        run_request_trace(engine, make_trace())  # warmup/compile
+        engine.metrics = ServingMetrics()
+        engine.metrics.decode_horizon = engine.decode_horizon
+        trace = make_trace()
+        t0 = time.perf_counter()
+        results = run_request_trace(engine, trace)
+        dt = time.perf_counter() - t0
+        assert all(r.id in results for _, r in trace)
+        s = engine.metrics.summary()
+        return s["n_generated"] / dt, s
+
+    n_dev = len(jax.devices())
+    sweep, skipped = {}, []
+    for tp in (1, 2, 4, 8):
+        if tp > n_dev:
+            skipped.append({"tp": tp, "why": f"host has {n_dev} devices"})
+            continue
+        r = point(tp)
+        if r is None:
+            skipped.append({"tp": tp, "why": "parity probe fell back"})
+            continue
+        tps, s = r
+        sweep[tp] = {
+            "tok_per_sec": round(tps, 1),
+            "tok_per_sec_per_chip": round(tps / tp, 1),
+            "scaling_efficiency": None,  # filled once tps(1) is known
+            "ttft_p50_s": round(s["ttft_p50_s"], 4),
+        }
+    if not sweep:
+        raise RuntimeError("no TP point ran (single-device host?)")
+    base = sweep.get(1, sweep[min(sweep)])["tok_per_sec"]
+    base_tp = 1 if 1 in sweep else min(sweep)
+    for tp, row in sweep.items():
+        row["scaling_efficiency"] = round(
+            row["tok_per_sec"] / (tp / base_tp * base), 3
+        )
+    widest = max(sweep)
+    tok_per_chip = sweep[widest]["tok_per_sec_per_chip"]
+    extra = {
+        "tp": widest,
+        "tp_sweep": {str(k): v for k, v in sweep.items()},
+        "skipped": skipped,
+        "scaling_efficiency": sweep[widest]["scaling_efficiency"],
+        "n_slots": n_slots,
+        "n_requests": n_requests,
+        "decode_horizon": 4,
+        "n_devices": n_dev,
+        "platform": jax.devices()[0].platform,
+    }
+    metric = "transformer_gpt2s_h96tp_decode_serve_tp_tokens_per_sec_per_chip"
+    return tok_per_chip, metric, extra
+
+
+def _bench_decode_serve_router(args, n_requests: int = 32,
+                               n_slots: int = 8,
+                               mean_interarrival_s: float = 0.01):
+    """Replica routing under shared-prefix traffic: TWO full serving
+    replicas (each a ``ServingServer`` with its own engine + radix
+    prefix cache) behind the :class:`~.serving.router.ReplicaRouter`,
+    driven over real HTTP with half the requests sharing one long
+    prompt prefix (system-prompt traffic). The trace runs twice: once
+    with prefix-affinity routing ON (shared-prefix requests pinned to
+    the replica whose shadow trie — hence prefix cache — already holds
+    the run) and once degraded to pure least-loaded/round-robin
+    (affinity threshold set beyond any prompt length). The headline is
+    ``ttft_p50_speedup``: affinity-routed TTFT p50 over round-robin
+    TTFT p50, pooled from both replicas' engine reservoirs — the
+    user-visible win of not splitting one prefix's traffic across
+    caches that each re-prefill it. The metric value is the affinity
+    run's aggregate routed tok/s."""
+    import http.client
+    import json as _json
+    import threading
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import init_transformer
+    from deeplearning4j_tpu.serving import (
+        RequestScheduler,
+        ServingEngine,
+        ServingMetrics,
+        ServingServer,
+    )
+    from deeplearning4j_tpu.serving.router import ReplicaRouter
+
+    cfg, _, p = _decode_bench_cfg(args, batch=1, gqa=True)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    sfx_len = min(64, _DECODE_PROMPT_LEN // 2)
+    pfx_len = _DECODE_PROMPT_LEN - sfx_len
+    shared = rng.integers(0, p["vocab"], (pfx_len,)).tolist()
+    uniq = rng.integers(
+        0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
+    ).astype(np.int32)
+
+    def make_bodies():
+        bodies = []
+        for i in range(n_requests):
+            if i % 2 == 0:  # 0.5 shared-prefix fraction, interleaved
+                prompt = shared + uniq[i, :sfx_len].tolist()
+            else:
+                prompt = uniq[i].tolist()
+            bodies.append({"prompt": prompt, "max_new": _DECODE_NEW})
+        return bodies
+
+    def post(addr, body):
+        conn = http.client.HTTPConnection(*addr, timeout=300)
+        try:
+            conn.request(
+                "POST", "/v1/generate", body=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            ok = resp.status == 200
+            n_tok = 0
+            if ok:
+                out = _json.loads(resp.read())
+                n_tok = len(out["tokens"]) - len(body["prompt"])
+            else:
+                resp.read()
+            return ok, n_tok
+        finally:
+            conn.close()
+
+    def run_mode(affinity: bool):
+        engines = [
+            ServingEngine(
+                cfg, params, n_slots=n_slots,
+                temperature=1.0, top_k=40,
+                approx_top_k=not args.exact_top_k,
+                prefix_cache=True,
+                scheduler=RequestScheduler(max_queue_depth=n_requests),
+            )
+            for _ in range(2)
+        ]
+        servers = [ServingServer(e, port=0).start() for e in engines]
+        router = ReplicaRouter(
+            [s.address for s in servers],
+            # round-robin mode: a threshold no prompt can reach
+            affinity_min_match=(8 if affinity
+                                else _DECODE_PROMPT_LEN + 1),
+        ).start()
+        try:
+            # warmup: compile both replicas' programs through the router
+            for body in make_bodies()[:4]:
+                post(router.address, body)
+            for e in engines:
+                if e.prefix_cache is not None:
+                    e.prefix_cache.reinit()
+                e.metrics = ServingMetrics()
+                e.metrics.decode_horizon = e.decode_horizon
+            bodies = make_bodies()
+            results = [None] * n_requests
+            threads = []
+            t0 = time.perf_counter()
+
+            def fire(i, body):
+                results[i] = post(router.address, body)
+
+            for i, body in enumerate(bodies):
+                delay = arrivals[i] - (time.perf_counter() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                t = threading.Thread(target=fire, args=(i, bodies[i]))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            assert all(ok for ok, _ in results), "routed request failed"
+            n_generated = sum(n for _, n in results)
+            ttft = [v for e in engines for v in e.metrics.ttft.values]
+            saved = sum(
+                e.metrics.prefix_tokens_saved for e in engines
+            )
+            per_replica = [e.metrics.summary()["n_finished"]
+                           for e in engines]
+            return {
+                "tok_per_sec": n_generated / dt,
+                "ttft_p50_s": float(np.percentile(ttft, 50)),
+                "ttft_p99_s": float(np.percentile(ttft, 99)),
+                "prefill_tokens_saved": saved,
+                "per_replica_finished": per_replica,
+            }
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+
+    aff = run_mode(affinity=True)
+    rr = run_mode(affinity=False)
+    tok_per_sec = aff["tok_per_sec"]
+    extra = {
+        "ttft_p50_s": round(aff["ttft_p50_s"], 4),
+        "ttft_p99_s": round(aff["ttft_p99_s"], 4),
+        "ttft_p50_speedup": round(
+            rr["ttft_p50_s"] / max(aff["ttft_p50_s"], 1e-9), 3
+        ),
+        "round_robin_ttft_p50_s": round(rr["ttft_p50_s"], 4),
+        "round_robin_tok_per_sec": round(rr["tok_per_sec"], 1),
+        "prefill_tokens_saved": aff["prefill_tokens_saved"],
+        "round_robin_tokens_saved": rr["prefill_tokens_saved"],
+        "per_replica_finished": aff["per_replica_finished"],
+        "shared_prefix_frac": 0.5,
+        "n_replicas": 2,
+        "n_requests": n_requests,
+        "n_slots": n_slots,
+    }
+    metric = ("transformer_gpt2s_h128_decode_serve_router_"
+              "tokens_per_sec_per_chip")
+    return tok_per_sec, metric, extra
+
+
 def _bench_resnet(args):
     """ResNet-20 (He CIFAR recipe) training throughput — the modern CNN
     family the reference's era lacked (its conv story stops at
@@ -1098,6 +1391,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-8kctx", "transformer-decode-gqa-8kctx-int8",
     "transformer-decode-serve", "transformer-decode-serve-faults",
     "transformer-decode-serve-prefix",
+    "transformer-decode-serve-tp", "transformer-decode-serve-router",
 )
 
 # measured-faster dtype per workload: bf16 for the MXU-bound ones, f32
@@ -1122,6 +1416,8 @@ _AUTO_DTYPE = {
     "transformer-decode-serve": "bf16",
     "transformer-decode-serve-faults": "bf16",
     "transformer-decode-serve-prefix": "bf16",
+    "transformer-decode-serve-tp": "bf16",
+    "transformer-decode-serve-router": "bf16",
 }
 
 
@@ -1235,6 +1531,18 @@ def _run_one_inner(args, jax) -> None:
             _report(args, per_chip, metric, jax, extra=extra,
                     remeasure=lambda: (
                         _bench_decode_serve_prefix(args)[0], None))
+            return
+        if args.model == "transformer-decode-serve-tp":
+            per_chip, metric, extra = _bench_decode_serve_tp(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (
+                        _bench_decode_serve_tp(args)[0], None))
+            return
+        if args.model == "transformer-decode-serve-router":
+            per_chip, metric, extra = _bench_decode_serve_router(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (
+                        _bench_decode_serve_router(args)[0], None))
             return
         if args.model in ("transformer-decode-serve",
                           "transformer-decode-serve-faults"):

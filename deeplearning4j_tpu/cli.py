@@ -488,6 +488,9 @@ def cmd_serve(args) -> int:
     if args.profile_steps > 0:
         d = profile.arm(args.profile_steps)
         print(f"profiling first {args.profile_steps} steps -> {d}")
+    probe_cache = None
+    if args.probe_cache and args.probe_cache.lower() not in ("off", "none"):
+        probe_cache = os.path.expanduser(args.probe_cache)
     engine = ServingEngine(
         cfg, params,
         n_slots=args.slots,
@@ -506,7 +509,19 @@ def cmd_serve(args) -> int:
         faults=faults,
         tracer=tracer,
         profile=profile,
+        tp=args.tp,
+        tp_parity={"auto": "auto", "trust": True, "off": False}[
+            args.tp_parity],
+        probe_cache=probe_cache,
     )
+    if args.tp > 1:
+        if engine.tp == args.tp:
+            print(f"tensor parallel: decode sharded over {engine.tp} "
+                  f"devices (model axis)")
+        else:
+            print(f"tensor parallel DISABLED (parity probe failed or "
+                  f"geometry unsupported); serving on 1 device",
+                  file=sys.stderr)
     server = ServingServer(
         engine, host=args.host, port=args.port,
         request_timeout_s=args.request_timeout,
@@ -568,6 +583,52 @@ def _write_port_file(path: str, server) -> None:
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
+
+
+def cmd_router(args) -> int:
+    """Run the prefix-affinity replica router in front of N running
+    `serve` processes. The router never loads a model: it forwards
+    POST /v1/generate to the healthy replica with the longest shared
+    prompt prefix (least-loaded otherwise), polls each replica's
+    /healthz, and retries never-accepted requests when a replica
+    dies. See serving/router.py."""
+    from deeplearning4j_tpu.obs import configure_json_logging
+    from deeplearning4j_tpu.serving.router import ReplicaRouter
+
+    if args.log_json:
+        configure_json_logging()
+    try:
+        router = ReplicaRouter(
+            args.replica,
+            host=args.host, port=args.port,
+            affinity_min_match=args.affinity_min_match,
+            health_interval_s=args.health_interval,
+            request_timeout_s=args.request_timeout,
+        )
+    except ValueError as e:
+        print(f"router: {e}", file=sys.stderr)
+        return 2
+    host, port = router.address
+    names = ", ".join(r.name for r in router.replicas)
+    print(f"routing on http://{host}:{port} -> [{names}]  "
+          f"(affinity >= {args.affinity_min_match} tokens, "
+          f"health poll {args.health_interval:g}s)")
+    if args.port_file:
+        router.start()
+        tmp = f"{args.port_file}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"host": host, "port": port}, f)
+        os.replace(tmp, args.port_file)
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            router.stop()
+    else:
+        router.serve_forever()
+    return 0
 
 
 def cmd_bench(args) -> int:
@@ -828,6 +889,27 @@ def main(argv: list[str] | None = None) -> int:
         help="weight-only int8 or the fully quantized path (int8 KV "
         "cache) — PERF.md r5",
     )
+    v.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel width: shard the fused decode "
+                   "program (attention heads, MLP columns, vocab) and "
+                   "the KV slot pool over the first N devices. Gated "
+                   "by a construction-time bitwise parity probe "
+                   "(--tp-parity); needs N dividing n_heads and "
+                   "kv_heads. 1 = single device")
+    v.add_argument("--tp-parity", default="auto",
+                   choices=["auto", "trust", "off"],
+                   help="auto: probe TP-vs-single-chip bitwise parity "
+                   "once at startup and fall back to tp=1 on mismatch; "
+                   "trust: skip the probe (models too big for one "
+                   "chip); off: disable TP entirely")
+    v.add_argument("--probe-cache",
+                   default="~/.cache/dl4j_tpu/probes.json",
+                   metavar="PATH",
+                   help="persist parity-probe verdicts (prefix reuse, "
+                   "batched admission, chunked replay, TP) keyed by "
+                   "(config, backend, geometry), so replica fleets and "
+                   "restarts skip cold-start probe dispatches. "
+                   "'off' disables persistence")
     # model flags for --demo / pre-config checkpoints
     v.add_argument("--seq-len", type=int, default=128)
     v.add_argument("--d-model", type=int, default=128)
@@ -836,6 +918,29 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("--n-experts", type=int, default=0)
     v.add_argument("--bf16", action="store_true")
     v.set_defaults(fn=cmd_serve)
+
+    r = sub.add_parser(
+        "router",
+        help="prefix-affinity router over N running serve replicas "
+        "(least-loaded dispatch, per-replica health, crash retry)",
+    )
+    r.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT",
+                   help="one backend serve address; repeat per replica")
+    r.add_argument("--host", default="127.0.0.1")
+    r.add_argument("--port", type=int, default=8000)
+    r.add_argument("--affinity-min-match", type=int, default=8,
+                   help="shared-prefix tokens before affinity overrides "
+                   "least-loaded dispatch (route to the replica whose "
+                   "prefix cache likely holds the matching KV)")
+    r.add_argument("--health-interval", type=float, default=0.5,
+                   help="seconds between /healthz polls of each replica")
+    r.add_argument("--request-timeout", type=float, default=300.0)
+    r.add_argument("--log-json", action="store_true")
+    r.add_argument("--port-file", default=None, metavar="PATH",
+                   help="write the bound address as JSON to PATH once "
+                   "listening (for harnesses using --port 0)")
+    r.set_defaults(fn=cmd_router)
 
     # add_help=False so `bench -h` reaches bench.py's parser, which
     # documents --model/--batch/--dtype

@@ -363,6 +363,109 @@ def place_transformer_params(mesh: Mesh, params, cfg=None):
     return jax.tree.map(mesh_lib.place_global, params, shardings)
 
 
+def serving_tp_shardings(mesh: Mesh, cfg: TransformerConfig):
+    """Exact-parity tensor-parallel SERVING layout over the mesh's model
+    axis, as a shardings pytree mirroring ``init_transformer``.
+
+    This is deliberately NOT :func:`transformer_shardings` (the training
+    Megatron layout): row-parallel ``wo``/``w2`` there make XLA psum
+    partial contractions, and the reassociated reduction drifts ~1e-6
+    from the single-chip result — enough to flip sampled draws and
+    break the serving engine's byte-identical parity bar. Here every
+    COLUMN projection is sharded (wq/wqkv/wkv on heads, w1/b1 on d_ff,
+    head on vocab) — each output element still reduces over the full
+    replicated contraction dim in single-chip order — while every ROW
+    projection (wo, w2) stays replicated and its sharded input
+    activation is all-gathered first (:func:`_tp_replicate` inside the
+    decode builders). Gathers are exact concatenations, so the whole
+    forward is bitwise identical to TP=1; the price is shipping
+    (B, D)/(B, d_ff) activations per layer instead of Megatron's one
+    psum, plus replicated wo/w2 weight streams — the sharded attention
+    (the part that scales with batch x context) is where the TP win
+    lives."""
+    m = mesh_lib.MODEL_AXIS
+    tp = mesh.shape[m]
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        raise ValueError(
+            f"exact-TP serving needs tp ({tp}) dividing n_heads "
+            f"({cfg.n_heads}) and kv_heads ({cfg.kv_heads})"
+        )
+    if cfg.n_experts:
+        raise ValueError("exact-TP serving does not support MoE configs")
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    rep = ns()
+    if cfg.kv_heads != cfg.n_heads:
+        attn = {
+            "wq": ns(None, None, m, None),
+            "wkv": ns(None, None, None, m, None),
+        }
+    else:
+        attn = {"wqkv": ns(None, None, None, m, None)}
+    return {
+        "embed": rep,
+        "pos": rep,
+        "blocks": {
+            "ln1_scale": rep,
+            "ln1_bias": rep,
+            **attn,
+            "wo": rep,  # row projection: replicated, input gathered
+            "ln2_scale": rep,
+            "ln2_bias": rep,
+            "w1": ns(None, None, m),  # column-parallel on d_ff
+            "b1": ns(None, m),
+            "w2": rep,  # row projection: replicated, input gathered
+            "b2": rep,
+        },
+        "lnf_scale": rep,
+        "lnf_bias": rep,
+        "head": ns(None, m),  # vocab-sharded logits, gathered at the tail
+    }
+
+
+def place_serving_tp_params(mesh: Mesh, params, cfg: TransformerConfig):
+    """Place a (float or int8-quantized) serving params pytree with the
+    exact-TP layout of :func:`serving_tp_shardings`; int8 ``name_scale``
+    leaves get shardings derived from their weight's spec, exactly as
+    :func:`place_transformer_params` does for the training layout."""
+    shardings = serving_tp_shardings(mesh, cfg)
+    blocks = params["blocks"]
+    if any(
+        name in blocks and blocks[name].dtype == jnp.int8
+        for name in _INT8_BLOCK_AXES
+    ):
+        sblocks = dict(shardings["blocks"])
+        for name, axes in _INT8_BLOCK_AXES.items():
+            if name + "_scale" in blocks:
+                sblocks[name + "_scale"] = _quantized_leaf_sharding(
+                    mesh, sblocks[name], axes
+                )
+        shardings = dict(shardings)
+        shardings["blocks"] = sblocks
+        if "head_scale" in params:
+            shardings["head_scale"] = _quantized_leaf_sharding(
+                mesh, shardings["head"], (0,)
+            )
+    return jax.tree.map(mesh_lib.place_global, params, shardings)
+
+
+def serving_tp_cache_sharding(mesh: Mesh, cfg: TransformerConfig):
+    """Sharding pytree for an ``init_caches`` allocation under exact-TP
+    serving: the packed (nl, 2, B, Tpad, Hkv*K) buffer sharded on its
+    head-major minor dim (each rank owns its kv heads' rows — writes
+    and attention reads stay rank-local). The int8 per-row scale plane
+    has a size-1 minor dim (one scale across ALL heads of a row,
+    computed via an exact cross-shard max) and is replicated."""
+    kv = NamedSharding(
+        mesh, P(None, None, None, None, mesh_lib.MODEL_AXIS)
+    )
+    if cfg.decode_int8:
+        return {"kv": kv, "scale": NamedSharding(mesh, P())}
+    return kv
+
+
 def _layer_norm(x, scale, bias, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
@@ -465,12 +568,39 @@ def _expand_kv(cfg: TransformerConfig, k_r, v_r):
     return jnp.repeat(k_r, g, axis=1), jnp.repeat(v_r, g, axis=1)
 
 
-def _mlp(p, h_in):
-    """Shared dense FFN (gelu) over (..., D) activations."""
+def _tp_replicate(x, tp_mesh):
+    """Force ``x`` replicated (an all-gather of its sharded axis) under
+    the exact-TP serving layout; identity when no mesh is given.
+
+    This is the load-bearing primitive of byte-exact tensor parallelism:
+    every matmul whose CONTRACTION dim would otherwise arrive sharded
+    (attention out @ wo, gelu hidden @ w2) gathers its activation first
+    and contracts against a REPLICATED weight, so the reduction runs in
+    the single-chip flop order. Left to GSPMD, a sharded contraction
+    becomes partial-sums + psum — a different association that drifts
+    ~1e-6 (measured on this backend), which breaks the engine's
+    byte-identical parity bar."""
+    if tp_mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(tp_mesh, P())
+    )
+
+
+def _mlp(p, h_in, tp_mesh=None):
+    """Shared dense FFN (gelu) over (..., D) activations.
+
+    Under the exact-TP serving layout (``tp_mesh`` set) ``w1``/``b1``
+    are column-sharded on d_ff and the gelu hidden is all-gathered
+    before the ``w2`` matmul against a REPLICATED ``w2`` — the d_ff
+    reduction then runs in the single-chip order, so the output is
+    bitwise identical to the unsharded path (a row-parallel ``w2``
+    would psum partial sums in a different association)."""
     h = jax.nn.gelu(
         jnp.einsum("...d,df->...f", h_in, _w(p, "w1", h_in.dtype))
         + p["b1"].astype(h_in.dtype)
     )
+    h = _tp_replicate(h, tp_mesh)
     return (
         jnp.einsum("...f,fd->...d", h, _w(p, "w2", h_in.dtype))
         + p["b2"].astype(h_in.dtype)
@@ -687,11 +817,24 @@ def transformer_loss(cfg: TransformerConfig, mesh: Mesh | None = None):
     return loss
 
 
-def _decode_builder(cfg: TransformerConfig):
+def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
     """Shared KV-cache decode machinery: returns
     ``(forward_one, init_caches, prefill)`` used by sampling and beam
     search. ``forward_one(params, caches, token, pos)`` advances one
-    position through all layers."""
+    position through all layers.
+
+    ``tp_mesh`` (a 1-D model-axis mesh) builds the exact-TP serving
+    variant: params placed per :func:`serving_tp_shardings`, caches per
+    :func:`serving_tp_cache_sharding`, sharded activations gathered
+    before every row projection (:func:`_tp_replicate`) so outputs are
+    bitwise identical to the unsharded program. Requires the dense
+    decode path (``decode_kernel=False``) — the Pallas decode kernel is
+    a custom call GSPMD cannot partition."""
+    if tp_mesh is not None and cfg.decode_kernel:
+        raise ValueError(
+            "tensor-parallel decode requires decode_kernel=False "
+            "(the Pallas kernel cannot be GSPMD-partitioned)"
+        )
 
     def quantize_kv_rows(rows):
         """Per-row int8 quantization of new cache rows: ``rows``
@@ -753,7 +896,9 @@ def _decode_builder(cfg: TransformerConfig):
             # (no separate copy to drift), used under SPMD sharding,
             # for debugging, and as speculative decoding's
             # numerics-matched draft mode
-            y, kv_all = _block_chunk(cfg, x[:, None, :], p, kv_all, i, pos)
+            y, kv_all = _block_chunk(
+                cfg, x[:, None, :], p, kv_all, i, pos, tp_mesh=tp_mesh
+            )
             return y[:, 0], kv_all
         b = x.shape[0]
         kd = cfg.head_dim
@@ -865,7 +1010,10 @@ def _decode_builder(cfg: TransformerConfig):
             "bd,dv->bv", x, _w(params, "head", x.dtype),
             preferred_element_type=jnp.float32,
         )
-        return logits, kv_all
+        # TP: vocab-sharded logits gather here (exact concatenation) so
+        # the host-visible logits buffer — and everything sampling reads
+        # — is replicated and bitwise identical to TP=1
+        return _tp_replicate(logits, tp_mesh), kv_all
 
     def cast_params(params):
         """One-time cast of the streamed weights to the compute dtype.
@@ -993,7 +1141,7 @@ def _decode_builder(cfg: TransformerConfig):
                     kv, kv_rows.astype(kv.dtype), (0, 0, 0, 0)
                 )
             k_h, v_h = _expand_kv(cfg, k_r, v_r)
-            if cfg.use_flash and _flash_seq_ok(tp):
+            if cfg.use_flash and _flash_seq_ok(tp) and tp_mesh is None:
                 # keep long-prompt prefill O(T) like training — dense
                 # attention would materialize (B, H, Tp, Tp) scores.
                 # Prompts of other lengths fall back to dense (inference
@@ -1011,6 +1159,10 @@ def _decode_builder(cfg: TransformerConfig):
                 )
             else:
                 o = attention(q, k_h, v_h, causal=True, layout="bhtd")
+            # TP: gather the head-sharded attention output before the
+            # row projection so the h*k reduction keeps single-chip
+            # order (see _tp_replicate)
+            o = _tp_replicate(o, tp_mesh)
             x = x + jnp.einsum("bhtk,hkd->btd", o, _w(p, "wo", x.dtype))
             h_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
             if cfg.n_experts:
@@ -1028,7 +1180,7 @@ def _decode_builder(cfg: TransformerConfig):
                 )
                 x = x + y.reshape(h_in.shape)
             else:
-                x = x + _mlp(p, h_in)
+                x = x + _mlp(p, h_in, tp_mesh)
             return x, kv
 
         x, kv_all = lax.scan(layer, x, (params["blocks"], kv_all))
@@ -1049,7 +1201,7 @@ def _decode_builder(cfg: TransformerConfig):
             "bd,dv->bv", x, _w(params, "head", x.dtype),
             preferred_element_type=jnp.float32,
         )  # bf16 operands, f32 accumulation — see forward_one
-        return kv_all, logits
+        return kv_all, _tp_replicate(logits, tp_mesh)
 
     return forward_one, init_caches, prefill, cast_params
 
@@ -1219,7 +1371,8 @@ def _filtered_probs(logits, temperature: float, top_k: int | None,
     return jax.nn.softmax(logits / temperature, axis=-1)
 
 
-def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0):
+def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0,
+                 tp_mesh=None):
     """One transformer block over C consecutive cached-decode positions
     (x: (B, C, D), rows pos0..pos0+C-1): projection, RoPE, cache write,
     dense masked attention against the cache, MLP/MoE tail. ONE
@@ -1307,6 +1460,9 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0):
     o_flat = o.transpose(0, 3, 1, 2, 4).reshape(
         b, c, cfg.n_heads * kd
     )
+    # TP: gather the head-sharded attention output before the row
+    # projection so the reduction keeps single-chip order
+    o_flat = _tp_replicate(o_flat, tp_mesh)
     x = x + jnp.einsum(
         "bch,hd->bcd", o_flat,
         _w(p, "wo", x.dtype).reshape(cfg.n_heads * kd, -1),
@@ -1326,10 +1482,10 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0):
         )
         x = x + y.reshape(h_in.shape)
     else:
-        x = x + _mlp(p, h_in)
+        x = x + _mlp(p, h_in, tp_mesh)
     return x, kv_all
 
-def _chunk_builder(cfg: TransformerConfig):
+def _chunk_builder(cfg: TransformerConfig, tp_mesh=None):
     """Chunked cached forward — the verify side of speculative decoding:
     ``forward_chunk(params, caches, toks (B, C), pos0)`` advances C
     consecutive positions (pos0..pos0+C-1) through all layers against
@@ -1354,7 +1510,9 @@ def _chunk_builder(cfg: TransformerConfig):
         kv_all = caches
         for i in range(cfg.n_layers):
             p_i = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
-            x, kv_all = _block_chunk(cfg, x, p_i, kv_all, i, pos0)
+            x, kv_all = _block_chunk(
+                cfg, x, p_i, kv_all, i, pos0, tp_mesh=tp_mesh
+            )
         if last_idx is not None:
             # single-row logits (bucketed-prefill chunking: only the
             # true last token's row matters; skips the (C, V) head).
@@ -1375,13 +1533,13 @@ def _chunk_builder(cfg: TransformerConfig):
                 "bd,dv->bv", x_last, _w(params, "head", x_last.dtype),
                 preferred_element_type=jnp.float32,
             )
-            return logits, kv_all
+            return _tp_replicate(logits, tp_mesh), kv_all
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
         logits = jnp.einsum(
             "bcd,dv->bcv", x, _w(params, "head", x.dtype),
             preferred_element_type=jnp.float32,
         )
-        return logits, kv_all
+        return _tp_replicate(logits, tp_mesh), kv_all
 
     return forward_chunk
 

@@ -50,6 +50,17 @@ def dp_mp_mesh(dp: int, mp: int) -> Mesh:
     )
 
 
+def model_parallel_mesh(tp: int) -> Mesh:
+    """1-D model-axis mesh over the first ``tp`` local devices — the
+    serving engine's tensor-parallel geometry. No data axis: the decode
+    slot batch stays whole on every rank (sharding it would split the
+    already-small per-step batch below MXU tile width); only heads,
+    d_ff columns and the vocab dim partition."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    return _mesh_1d(MODEL_AXIS, tp)
+
+
 def expert_mesh(n_devices: int | None = None) -> Mesh:
     """1-D expert mesh: tokens are data-sharded over the same devices that
     hold the experts (GShard layout), so dispatch is one all-to-all."""

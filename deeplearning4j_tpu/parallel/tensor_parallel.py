@@ -10,6 +10,18 @@ over the mesh's model axis:
   summed with ``psum`` over ICI.
 - ``tp_mlp_block``: column -> nonlinearity -> row, the canonical pairing
   with exactly one AllReduce per block.
+
+SERVING uses a different, byte-exact variant of this layout
+(:func:`serving_tp_shardings` below, defined next to the model): the
+row-parallel halves (wo, w2) stay REPLICATED and their sharded input
+activations are all-gathered first, so every floating-point reduction
+keeps the single-chip flop order — Megatron's psum of partial products
+reassociates the sum and drifts ~1e-6, which would break the serving
+engine's byte-identical parity bar. Column projections (attention
+heads, d_ff, vocab) shard exactly as here; the KV cache shards on its
+packed head axis (:func:`serving_tp_cache_sharding`), so per-slot
+slabs, the prefix-cache region, slab copies, bucketed prefill and
+chunked replay all run under one sharding.
 """
 
 from __future__ import annotations
@@ -57,3 +69,22 @@ def shard_dense_params(mesh, w1, b1, w2, b2):
         jax.device_put(w2, NamedSharding(mesh, P(axis, None))),
         jax.device_put(b2, NamedSharding(mesh, P())),
     )
+
+
+def serving_tp_shardings(mesh, cfg):
+    """Exact-parity serving TP layout for a transformer params pytree —
+    see the module docstring and the implementation (kept next to
+    ``init_transformer`` so layouts cannot drift from the param tree)."""
+    from deeplearning4j_tpu.models.transformer import serving_tp_shardings as f
+
+    return f(mesh, cfg)
+
+
+def serving_tp_cache_sharding(mesh, cfg):
+    """Head-axis sharding for a decode-cache allocation under serving
+    TP (pool slabs and the prefix-cache region share it)."""
+    from deeplearning4j_tpu.models.transformer import (
+        serving_tp_cache_sharding as f,
+    )
+
+    return f(mesh, cfg)

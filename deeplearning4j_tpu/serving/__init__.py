@@ -27,6 +27,10 @@ Public surface:
   deterministic (seeded or scripted) fault injection at engine
   boundaries, driving the supervised step loop / replay recovery
   (chaos tests: ``tests/test_serving_faults.py``).
+- :class:`~deeplearning4j_tpu.serving.router.ReplicaRouter` — host-side
+  front end over N engine replicas: prefix-affinity dispatch via a
+  shadow token trie, least-loaded otherwise, per-replica health with
+  retry onto survivors (``router`` subcommand).
 """
 
 from deeplearning4j_tpu.serving.cache_pool import KVSlotPool  # noqa: F401
@@ -42,6 +46,7 @@ from deeplearning4j_tpu.serving.faults import (  # noqa: F401
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
+from deeplearning4j_tpu.serving.router import ReplicaRouter  # noqa: F401
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Backpressure,

@@ -12,11 +12,19 @@ sized to the engine's token budget.
 
 Slots are handed out lowest-index-first so admission order is
 deterministic — tests (and trace replays) rely on it.
+
+Under tensor-parallel serving the pool carries a ``sharding`` pytree
+(:func:`~deeplearning4j_tpu.models.transformer.serving_tp_cache_sharding`):
+every allocation this pool hands out — the decode cache, crash-recovery
+re-creations, and the prefix-cache segment region from
+:meth:`alloc_region` — is placed with it, so pool slabs and region
+slabs stay interchangeable under the same dynamic-slice programs.
 """
 
 from __future__ import annotations
 
 import heapq
+import math
 
 import jax
 
@@ -34,13 +42,15 @@ class KVSlotPool:
     functionally; with buffer donation the update is in place.
     """
 
-    def __init__(self, cfg: TransformerConfig, n_slots: int, max_total: int):
+    def __init__(self, cfg: TransformerConfig, n_slots: int, max_total: int,
+                 sharding=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         _, init_caches, _, _ = _decode_builder(cfg)
         self._init_caches = init_caches
         self._max_total = max_total
-        self.caches = init_caches(n_slots, max_total)
+        self._sharding = sharding
+        self.caches = self._place(init_caches(n_slots, max_total))
         kv = self.caches["kv"] if isinstance(self.caches, dict) else self.caches
         self.n_slots = n_slots
         self.tpad = kv.shape[3]  # rounded-up row count per slot
@@ -51,6 +61,24 @@ class KVSlotPool:
         # and re-acquired after its dispatch — the generation lets the
         # engine tell the block belongs to the previous occupant
         self._gen = [0] * n_slots
+        # byte sizes captured ONCE at allocation time (shape/dtype are
+        # host metadata): metrics scrapes must never walk the live
+        # device pytree (under donation a buffer can be
+        # mid-invalidation, and under TP the per-scrape answer must not
+        # depend on which shard you ask) — zero device interaction per
+        # scrape
+        self._nbytes = sum(
+            math.prod(x.shape) * x.dtype.itemsize
+            for x in jax.tree.leaves(self.caches)
+        )
+        self._nbytes_per_slot = self._nbytes // n_slots
+
+    def _place(self, caches):
+        """Place a fresh allocation with the pool's sharding (identity
+        when unsharded)."""
+        if self._sharding is None:
+            return caches
+        return jax.tree.map(jax.device_put, caches, self._sharding)
 
     @property
     def n_free(self) -> int:
@@ -87,13 +115,20 @@ class KVSlotPool:
 
     def alloc_region(self, n_slots: int):
         """A second bounded cache region with the SAME per-slot layout
-        as the pool — Tpad row count, dtype, int8 scale planes — so a
-        region slab and a pool slab are interchangeable under plain
-        dynamic slices. This is how the prefix cache gets its segment
-        store: the pool owns the layout, the cache owns the slots."""
+        as the pool — Tpad row count, dtype, int8 scale planes, and
+        (under TP) the same head-axis sharding — so a region slab and a
+        pool slab are interchangeable under plain dynamic slices. This
+        is how the prefix cache gets its segment store: the pool owns
+        the layout, the cache owns the slots."""
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
-        return self._init_caches(n_slots, self._max_total)
+        return self._place(self._init_caches(n_slots, self._max_total))
+
+    def region_nbytes(self, n_slots: int) -> int:
+        """Host-metadata byte size of an ``alloc_region(n_slots)``
+        allocation (the prefix cache reports this instead of walking
+        its live device pytree on metrics scrapes)."""
+        return self._nbytes_per_slot * n_slots
 
     def reinit(self) -> None:
         """Re-create the pooled cache buffers, zeroed (crash recovery:
@@ -102,8 +137,12 @@ class KVSlotPool:
         mid-step). Free-list/occupancy bookkeeping is preserved; the
         engine re-prefills every live slot afterwards (see
         ``ServingEngine.recover``)."""
-        self.caches = self._init_caches(self.n_slots, self._max_total)
+        self.caches = self._place(
+            self._init_caches(self.n_slots, self._max_total)
+        )
 
     def nbytes(self) -> int:
-        """Device bytes of the pooled cache (all slots)."""
-        return sum(x.nbytes for x in jax.tree.leaves(self.caches))
+        """Device bytes of the pooled cache (all slots; global logical
+        bytes under TP). Precomputed host metadata — never touches the
+        live device arrays, so metrics scrapes cost no device sync."""
+        return self._nbytes

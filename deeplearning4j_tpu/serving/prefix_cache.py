@@ -55,8 +55,8 @@ class Segment:
     a FULL hit replays those logits directly, so a fully-cached
     admission dispatches zero prefill programs."""
 
-    __slots__ = ("slot", "length", "node", "refs", "last_use", "logits",
-                 "alive")
+    __slots__ = ("slot", "length", "node", "refs", "last_use", "hits",
+                 "logits", "alive")
 
     def __init__(self, slot: int, length: int, node: "_Node"):
         self.slot = slot
@@ -64,6 +64,7 @@ class Segment:
         self.node = node
         self.refs = 0          # in-flight admissions reading this segment
         self.last_use = 0      # LRU tick, updated on lookup hit
+        self.hits = 0          # lifetime lookup hits (eviction weighting)
         self.logits = None     # device (1, V) row, set by the engine
         self.alive = True      # False once evicted (guards stale unpins)
 
@@ -102,14 +103,24 @@ class PrefixCache:
 
     def __init__(self, pool: KVSlotPool, capacity_tokens: int,
                  on_evict: Callable[[Segment], None] | None = None,
-                 min_seg_len: int = 1):
+                 min_seg_len: int = 1, hit_weight: float = 4.0):
         self.tpad = pool.tpad
         self.n_region_slots = max(1, int(capacity_tokens) // self.tpad)
         self.capacity_tokens = self.n_region_slots * self.tpad
         self._alloc_region = lambda: pool.alloc_region(self.n_region_slots)
         self.region = self._alloc_region()
+        # region byte size is fixed for the cache's lifetime: take it
+        # from the pool's host metadata so metrics scrapes never walk
+        # the live device pytree (see KVSlotPool.region_nbytes)
+        self._nbytes = pool.region_nbytes(self.n_region_slots)
         self.on_evict = on_evict
         self.min_seg_len = max(1, int(min_seg_len))  # branch-seg floor
+        # eviction score = last_use + hit_weight * hits: each lifetime
+        # hit buys the segment hit_weight LRU ticks of extra survival,
+        # so a hot system-prompt segment outlives colder-but-newer ones
+        # under churn instead of rotating out the moment traffic mixes
+        # (flat LRU's failure mode). 0 restores pure LRU.
+        self.hit_weight = float(hit_weight)
         self._root = _Node((), None)
         self._free: list[int] = list(range(self.n_region_slots))  # heap
         self._segments: set[Segment] = set()
@@ -133,10 +144,10 @@ class PrefixCache:
         return sum(1 for s in self._segments if s.refs > 0)
 
     def nbytes(self) -> int:
-        """Device bytes of the segment region."""
-        import jax
-
-        return sum(x.nbytes for x in jax.tree.leaves(self.region))
+        """Device bytes of the segment region (global logical bytes
+        under TP). Precomputed host metadata — scrapes never touch the
+        live device arrays."""
+        return self._nbytes
 
     def stats(self) -> dict:
         return {
@@ -147,6 +158,7 @@ class PrefixCache:
             "evictions": self.n_evictions,
             "inserts": self.n_inserts,
             "insert_declined": self.n_insert_declined,
+            "hits_recorded": sum(s.hits for s in self._segments),
         }
 
     # -- tree --------------------------------------------------------------
@@ -174,6 +186,7 @@ class PrefixCache:
         if best is not None:
             self._tick += 1
             best.last_use = self._tick
+            best.hits += 1
         return best, best_depth
 
     def insert(self, tokens: Iterable[int]) -> list[Segment]:
@@ -301,15 +314,22 @@ class PrefixCache:
         return None
 
     def _evict_one(self) -> bool:
-        """Drop the least-recently-used UNPINNED segment. Pinned
-        segments (refs > 0 — referenced by an active slot's in-flight
-        admission) are never candidates, so eviction can fail even at
-        full capacity; the caller declines the insert instead."""
+        """Drop the UNPINNED segment with the lowest hit-weighted
+        recency score (``last_use + hit_weight * hits`` — see
+        ``__init__``; ties broken by raw recency, then slot index for
+        determinism). Pinned segments (refs > 0 — referenced by an
+        active slot's in-flight admission) are never candidates, so
+        eviction can fail even at full capacity; the caller declines
+        the insert instead."""
         victim: Segment | None = None
+        vscore = None
         for seg in self._segments:
-            if seg.refs == 0 and (victim is None
-                                  or seg.last_use < victim.last_use):
-                victim = seg
+            if seg.refs:
+                continue
+            score = (seg.last_use + self.hit_weight * seg.hits,
+                     seg.last_use, seg.slot)
+            if victim is None or score < vscore:
+                victim, vscore = seg, score
         if victim is None:
             return False
         self._drop(victim)

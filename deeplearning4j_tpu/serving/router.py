@@ -1,0 +1,468 @@
+"""Prefix-affinity replica router: one thin process in front of N
+serving replicas.
+
+Tensor parallelism (``ServingEngine(tp=...)``) scales one model copy
+across chips; the router scales *throughput* across model copies. It
+is deliberately dumb about models — it never tokenizes, never touches
+a device, and holds no request state beyond in-flight counters — so a
+replica fleet is just N ``ServingServer`` processes plus this.
+
+Routing policy (in priority order):
+
+1. **Prefix affinity.** The router keeps a host-side token trie per
+   replica — a shadow of every prompt it has routed there. A new
+   prompt goes to the healthy replica whose shadow reports the longest
+   shared prefix, when that match reaches ``affinity_min_match``
+   tokens: that replica's radix prefix cache (PR 5) almost certainly
+   still holds the matching KV run, so routing anywhere else forfeits
+   the prefill savings. The shadow is an over-approximation of the
+   replica's real cache (it never sees evictions) — a stale hit costs
+   one ordinary prefill, never a wrong answer, so the router stays
+   decoupled from replica cache internals.
+2. **Least loaded.** Otherwise the replica with the fewest router-side
+   in-flight requests wins, round-robin on ties.
+
+Failure handling mirrors the per-replica supervision already inside
+``ServingServer``: an engine crash *inside* a replica is invisible
+here (the replica's supervisor replays and the blocked forward simply
+takes longer), while a dead replica *process* surfaces as a connect
+error or 503 — the router marks it unhealthy, retries the request on
+the remaining healthy replicas (generate submits are idempotent until
+accepted: a connect/send failure means the replica never admitted it),
+and a background poller flips the replica back to healthy once its
+``/healthz`` answers 200 again.
+
+Endpoints: ``POST /v1/generate`` (routed passthrough; replica status
+codes and bodies are forwarded verbatim, plus ``X-Served-By``),
+``GET /healthz`` (200 while >= 1 replica is healthy), ``GET /replicas``
+(per-replica routing state), ``GET /metrics`` (Prometheus text for the
+router's own counters/gauges, labelled per replica).
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from deeplearning4j_tpu.obs.logs import log_event
+from deeplearning4j_tpu.obs.registry import MetricsRegistry
+from deeplearning4j_tpu.utils.httpjson import (
+    QuietHandler,
+    read_json_body,
+    send_body,
+    send_json,
+)
+
+_log = logging.getLogger(__name__)
+
+#: Prometheus text exposition format version served at /metrics
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _ReplicaDown(Exception):
+    """Transport-level failure talking to a replica (connect/send/read
+    error or a 503) — the request was not accepted there."""
+
+
+class PrefixShadow:
+    """Host-side token trie over the prompts routed to one replica.
+
+    ``longest_match`` is the router's estimate of how many prompt
+    tokens the replica's prefix cache could reuse. Memory is bounded by
+    ``max_nodes`` (one dict entry per distinct token position); at the
+    cap the trie resets wholesale — crude, but affinity only needs
+    recent history, and a cold shadow merely degrades to least-loaded
+    routing until it re-learns.
+    """
+
+    __slots__ = ("_root", "_nodes", "max_nodes", "resets")
+
+    def __init__(self, max_nodes: int = 1_000_000):
+        self._root: dict = {}
+        self._nodes = 0
+        self.max_nodes = max_nodes
+        self.resets = 0
+
+    def insert(self, tokens) -> None:
+        if self._nodes >= self.max_nodes:
+            self._root = {}
+            self._nodes = 0
+            self.resets += 1
+        node = self._root
+        for t in tokens:
+            t = int(t)
+            nxt = node.get(t)
+            if nxt is None:
+                nxt = node[t] = {}
+                self._nodes += 1
+            node = nxt
+
+    def longest_match(self, tokens) -> int:
+        node = self._root
+        n = 0
+        for t in tokens:
+            node = node.get(int(t))
+            if node is None:
+                break
+            n += 1
+        return n
+
+    def __len__(self) -> int:
+        return self._nodes
+
+
+class _Replica:
+    """Router-side view of one backend ``ServingServer``."""
+
+    __slots__ = ("host", "port", "healthy", "in_flight", "routed",
+                 "affinity_routed", "retried_away", "shadow",
+                 "last_health", "lock")
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = int(port)
+        # optimistic until the first poll: a router started moments
+        # before its replicas shouldn't 503 the first request wave
+        self.healthy = True
+        self.in_flight = 0
+        self.routed = 0
+        self.affinity_routed = 0
+        self.retried_away = 0
+        self.shadow = PrefixShadow()
+        self.last_health: dict | None = None
+        self.lock = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def state(self) -> dict:
+        return {
+            "healthy": self.healthy,
+            "in_flight": self.in_flight,
+            "routed": self.routed,
+            "affinity_routed": self.affinity_routed,
+            "retried_away": self.retried_away,
+            "shadow_nodes": len(self.shadow),
+            "last_health": self.last_health,
+        }
+
+
+def _parse_replica(spec) -> tuple[str, int]:
+    """Accept ``(host, port)`` tuples or ``"host:port"`` strings."""
+    if isinstance(spec, str):
+        host, _, port = spec.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"replica spec {spec!r} is not host:port")
+        return host, int(port)
+    host, port = spec
+    return str(host), int(port)
+
+
+class ReplicaRouter:
+    """HTTP router over N serving replicas; ``start()`` is non-blocking.
+
+    ``affinity_min_match`` — minimum shared-prefix length (tokens)
+    before affinity overrides least-loaded dispatch. ``health_interval_s``
+    — background ``/healthz`` poll period; a replica is also marked
+    unhealthy *immediately* when a forward to it fails at transport
+    level, so the poll interval bounds recovery detection, not failure
+    detection.
+    """
+
+    def __init__(self, replicas, host: str = "127.0.0.1", port: int = 0,
+                 affinity_min_match: int = 8,
+                 health_interval_s: float = 0.5,
+                 request_timeout_s: float = 300.0):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.replicas = [
+            _Replica(*_parse_replica(spec)) for spec in replicas
+        ]
+        self.affinity_min_match = int(affinity_min_match)
+        self.health_interval_s = float(health_interval_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self._stop = threading.Event()
+        self._route_lock = threading.Lock()
+        self._rr = 0  # round-robin tie-break cursor
+
+        reg = self.registry = MetricsRegistry()
+        self._m_requests = reg.counter(
+            "router_requests_total", "Requests accepted by the router.")
+        self._m_routed = reg.counter(
+            "router_routed_total", "Requests dispatched, per replica.",
+            labelnames=("replica",))
+        self._m_affinity = reg.counter(
+            "router_affinity_total",
+            "Dispatches where prefix affinity overrode least-loaded.")
+        self._m_retries = reg.counter(
+            "router_retries_total",
+            "Forwards retried on another replica after a transport "
+            "failure.")
+        self._m_no_replica = reg.counter(
+            "router_no_replica_total",
+            "Requests failed because no healthy replica remained.")
+        self._m_healthy = reg.gauge(
+            "router_replica_healthy", "1 while the replica is routable.",
+            labelnames=("replica",))
+        self._m_in_flight = reg.gauge(
+            "router_replica_in_flight",
+            "Router-side in-flight requests, per replica.",
+            labelnames=("replica",))
+        for r in self.replicas:
+            self._m_healthy.set(1.0, replica=r.name)
+            self._m_in_flight.set(0.0, replica=r.name)
+
+        router = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path == "/healthz":
+                    payload = router.health_payload()
+                    send_json(self, 200 if payload["ok"] else 503, payload)
+                elif path == "/replicas":
+                    send_json(self, 200, router.replica_states())
+                elif path == "/metrics":
+                    send_body(self, 200, reg.render().encode(),
+                              PROM_CONTENT_TYPE)
+                else:
+                    send_json(self, 404, {"error": "not found"})
+
+            def do_POST(self):
+                if urlparse(self.path).path != "/v1/generate":
+                    send_json(self, 404, {"error": "not found"})
+                    return
+                if router._stop.is_set():
+                    send_json(self, 503, {"error": "router stopped"})
+                    return
+                body = read_json_body(self)
+                if body is None:
+                    send_json(self, 400, {"error": "malformed JSON"})
+                    return
+                code, payload, served_by = router.route(body)
+                # forward the replica's JSON verbatim, tagging which
+                # backend actually served it (observability + tests)
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                if served_by is not None:
+                    self.send_header("X-Served-By", served_by)
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True)
+
+    # ------------------------------------------------------------- #
+    # routing                                                        #
+    # ------------------------------------------------------------- #
+
+    @staticmethod
+    def _prompt_tokens(body: dict) -> list[int]:
+        """The prompt as affinity tokens; text prompts use the repo's
+        byte-level convention (latin-1 per byte), mirroring the
+        replica's own parsing so shadow tries match what replicas
+        cache."""
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            return list(prompt.encode("latin-1", errors="replace"))
+        if isinstance(prompt, list):
+            try:
+                return [int(t) for t in prompt]
+            except (TypeError, ValueError):
+                return []
+        return []
+
+    def _pick(self, tokens, exclude: set[str]) -> tuple[_Replica, bool]:
+        """Choose a healthy replica for ``tokens``; returns
+        ``(replica, via_affinity)``. Raises ``_ReplicaDown`` when no
+        healthy candidate remains."""
+        with self._route_lock:
+            candidates = [
+                r for r in self.replicas
+                if r.healthy and r.name not in exclude
+            ]
+            if not candidates:
+                raise _ReplicaDown("no healthy replica")
+            best, best_match = None, -1
+            for r in candidates:
+                m = r.shadow.longest_match(tokens)
+                # ties go to the less-loaded replica so identical
+                # shadows (e.g. empty) don't pile onto one backend
+                if m > best_match or (
+                    m == best_match and r.in_flight < best.in_flight
+                ):
+                    best, best_match = r, m
+            if best_match >= self.affinity_min_match:
+                chosen, via_affinity = best, True
+            else:
+                self._rr += 1
+                lo = min(r.in_flight for r in candidates)
+                tied = [r for r in candidates if r.in_flight == lo]
+                chosen = tied[self._rr % len(tied)]
+                via_affinity = False
+            chosen.in_flight += 1
+            chosen.routed += 1
+            if via_affinity:
+                chosen.affinity_routed += 1
+            if tokens:
+                chosen.shadow.insert(tokens)
+            self._m_in_flight.set(
+                float(chosen.in_flight), replica=chosen.name)
+            return chosen, via_affinity
+
+    def _forward(self, replica: _Replica, raw: bytes) -> tuple[int, bytes]:
+        """POST the raw body to the replica's generate endpoint.
+        Transport failures and 503 (draining / dead engine) raise
+        ``_ReplicaDown`` so the caller retries elsewhere."""
+        conn = http.client.HTTPConnection(
+            replica.host, replica.port, timeout=self.request_timeout_s)
+        try:
+            conn.request(
+                "POST", "/v1/generate", body=raw,
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status == 503:
+                raise _ReplicaDown(f"{replica.name} answered 503")
+            return resp.status, payload
+        except (OSError, http.client.HTTPException) as e:
+            raise _ReplicaDown(f"{replica.name}: {e}") from e
+        finally:
+            conn.close()
+
+    def route(self, body: dict) -> tuple[int, bytes, str | None]:
+        """Route one generate request; returns
+        ``(status, payload_bytes, replica_name | None)``. Retries on
+        the remaining healthy replicas after transport-level failures
+        (the failed replica never accepted the request)."""
+        import json
+
+        self._m_requests.inc()
+        tokens = self._prompt_tokens(body)
+        raw = json.dumps(body).encode()
+        exclude: set[str] = set()
+        while True:
+            try:
+                replica, via_affinity = self._pick(tokens, exclude)
+            except _ReplicaDown:
+                self._m_no_replica.inc()
+                return 503, json.dumps(
+                    {"error": "no healthy replica"}).encode(), None
+            self._m_routed.inc(replica=replica.name)
+            if via_affinity:
+                self._m_affinity.inc()
+            try:
+                status, payload = self._forward(replica, raw)
+                return status, payload, replica.name
+            except _ReplicaDown as e:
+                self._mark_unhealthy(replica, str(e))
+                replica.retried_away += 1
+                self._m_retries.inc()
+                exclude.add(replica.name)
+                log_event(_log, "router_retry", replica=replica.name,
+                          error=str(e))
+            finally:
+                with self._route_lock:
+                    replica.in_flight -= 1
+                    self._m_in_flight.set(
+                        float(replica.in_flight), replica=replica.name)
+
+    # ------------------------------------------------------------- #
+    # health                                                         #
+    # ------------------------------------------------------------- #
+
+    def _mark_unhealthy(self, replica: _Replica, why: str) -> None:
+        if replica.healthy:
+            replica.healthy = False
+            self._m_healthy.set(0.0, replica=replica.name)
+            log_event(_log, "router_replica_down",
+                      replica=replica.name, error=why)
+
+    def _poll_one(self, replica: _Replica) -> None:
+        conn = http.client.HTTPConnection(
+            replica.host, replica.port,
+            timeout=max(0.25, self.health_interval_s))
+        try:
+            import json
+
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                replica.last_health = json.loads(raw)
+            except ValueError:
+                replica.last_health = None
+            ok = resp.status == 200
+        except (OSError, http.client.HTTPException):
+            replica.last_health = None
+            ok = False
+        finally:
+            conn.close()
+        if ok and not replica.healthy:
+            replica.healthy = True
+            self._m_healthy.set(1.0, replica=replica.name)
+            log_event(_log, "router_replica_up", replica=replica.name)
+        elif not ok:
+            self._mark_unhealthy(replica, "healthz poll failed")
+
+    def poll_health(self) -> None:
+        """One synchronous poll of every replica (tests use this to
+        avoid sleeping for the background interval)."""
+        for r in self.replicas:
+            self._poll_one(r)
+
+    def _health_loop(self) -> None:
+        while not self._stop.is_set():
+            self.poll_health()
+            self._stop.wait(self.health_interval_s)
+
+    def health_payload(self) -> dict:
+        healthy = [r.name for r in self.replicas if r.healthy]
+        return {
+            "ok": bool(healthy),
+            "healthy": healthy,
+            "replicas": {r.name: r.healthy for r in self.replicas},
+        }
+
+    def replica_states(self) -> dict:
+        return {r.name: r.state() for r in self.replicas}
+
+    # ------------------------------------------------------------- #
+    # lifecycle                                                      #
+    # ------------------------------------------------------------- #
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def start(self) -> "ReplicaRouter":
+        self._http_thread.start()
+        self._health_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._health_thread.ident:
+            self._health_thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        """Blocking convenience for the CLI; Ctrl-C stops."""
+        self.start()
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
