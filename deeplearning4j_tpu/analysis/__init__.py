@@ -1,0 +1,60 @@
+"""graftlint: static analysis + runtime sanitizers for this repo's
+proven bug classes.
+
+Every rule here is derived from a bug this codebase actually hit (or a
+class the serving roadmap is about to make more likely):
+
+- **host-sync** — an implicit device->host sync (``np.asarray`` /
+  ``.item()`` / ``float()`` / ``bool()`` on a jax value) inside a
+  function marked ``# lint: hot-path``. The engine's pipelined decode
+  loop budgets exactly ONE blocking sync per horizon; any other sync
+  serializes dispatch against readback and silently halves throughput.
+- **zero-copy-alias** — ``jnp.asarray(x)`` over a mutable numpy buffer
+  that is also mutated elsewhere (the exact PR-2 race: on CPU,
+  ``jnp.asarray`` can zero-copy alias host memory while dispatch is
+  async, so a later host write lands inside an in-flight program).
+- **prng-reuse** — a jax PRNG key consumed by two sinks without an
+  intervening ``split``/``fold_in`` (the pre-PR-4 sampled-recovery bug
+  class: replay re-drew from an already-consumed key stream).
+- **lock-discipline** — attributes annotated ``# guarded-by: <lock>``
+  accessed outside a lexical ``with <lock>:`` block (Eraser-style
+  static lockset).
+- **retrace-hazard** — ``jax.jit`` applied at a call site in a way
+  that defeats its trace cache (immediate invocation outside
+  construction, or jit-in-a-loop). The dynamic complement is
+  :class:`~deeplearning4j_tpu.analysis.sanitizers.CompileCountGuard`,
+  which asserts the O(log max_len) prefill-program bound at runtime.
+
+Static rules are pure-stdlib ``ast`` passes (no imports of the linted
+code), run via ``python -m deeplearning4j_tpu lint`` with a checked-in
+baseline (``.graftlint.json``) for accepted sites. Runtime sanitizers
+(:mod:`.sanitizers`) are opt-in and zero-cost when off — the same bar
+as the PR-4 tracer: the disabled path is a single attribute/global
+``None`` check.
+"""
+
+from deeplearning4j_tpu.analysis.core import Finding, ModuleInfo
+from deeplearning4j_tpu.analysis.baseline import Baseline
+from deeplearning4j_tpu.analysis.rules import RULES, run_rules
+from deeplearning4j_tpu.analysis.sanitizers import (
+    CompileCountGuard,
+    LockSanitizer,
+    SanitizerViolation,
+    SyncSanitizer,
+    note_access,
+    wrap_lock,
+)
+
+__all__ = [
+    "Baseline",
+    "CompileCountGuard",
+    "Finding",
+    "LockSanitizer",
+    "ModuleInfo",
+    "RULES",
+    "SanitizerViolation",
+    "SyncSanitizer",
+    "note_access",
+    "run_rules",
+    "wrap_lock",
+]

@@ -1,0 +1,491 @@
+"""The five graftlint rules, each an ``ast`` pass over one module.
+
+Every rule returns a list of :class:`~.core.Finding`; inline allow
+annotations (``# lint: sync-ok <reason>`` etc.) suppress a site at the
+source, the checked-in baseline suppresses it centrally. See the
+package docstring for the bug class behind each rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    call_name,
+    dotted,
+    iter_functions,
+    self_attr,
+)
+
+#: module aliases this repo uses (import numpy as np / jax.numpy as jnp)
+_NP = {"np", "numpy"}
+_JNP = {"jnp", "jax.numpy"}
+#: jax.random functions that DERIVE keys rather than consuming them
+_KEY_DERIVERS = {"split", "fold_in", "key", "PRNGKey", "wrap_key_data",
+                 "key_data", "clone"}
+
+
+# -- rule 1: host-sync ----------------------------------------------------
+
+def check_host_sync(mod: ModuleInfo) -> list[Finding]:
+    """Implicit device->host syncs inside ``# lint: hot-path``
+    functions. Only designated sync points (``# lint: sync-ok``) are
+    allowed: the engine's pipelined readback budgets ONE blocking sync
+    per horizon, and any extra one serializes dispatch against
+    readback."""
+    out: list[Finding] = []
+    for fn, qual in iter_functions(mod.tree):
+        if mod.def_directive(fn, "hot-path") is None:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _sync_call_label(node)
+            if label is None:
+                continue
+            if mod.has_directive(node, "sync-ok"):
+                continue
+            out.append(mod.finding(
+                "host-sync", node,
+                f"{label} in hot-path function {qual!r} is an implicit "
+                f"device->host sync; annotate the designated readback "
+                f"point with '# lint: sync-ok <reason>' or move the "
+                f"sync off the hot path",
+                qual,
+            ))
+    return out
+
+
+def _sync_call_label(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        base = dotted(fn.value)
+        if fn.attr in ("asarray", "array") and base in _NP:
+            return f"{base}.{fn.attr}()"
+        if fn.attr == "device_get" and base == "jax":
+            return "jax.device_get()"
+        if fn.attr == "item" and not node.args and not node.keywords:
+            return ".item()"
+    elif isinstance(fn, ast.Name) and fn.id in ("float", "bool"):
+        if len(node.args) == 1 and isinstance(
+            node.args[0], (ast.Name, ast.Attribute, ast.Subscript)
+        ):
+            return f"{fn.id}()"
+    return None
+
+
+# -- rule 2: zero-copy-alias ----------------------------------------------
+
+def check_zero_copy_alias(mod: ModuleInfo) -> list[Finding]:
+    """``jnp.asarray(x)`` over a mutable numpy buffer that is also
+    mutated elsewhere — the PR-2 race: on CPU ``jnp.asarray`` can
+    zero-copy alias host memory while dispatch is async, so a later
+    host write lands inside an in-flight program. Pass a defensive
+    ``.copy()`` (as the engine's dispatch does) or annotate
+    ``# lint: alias-ok <reason>``."""
+    out: list[Finding] = []
+
+    # class-attribute variant: self.X subscript-mutated anywhere in the
+    # class AND passed bare to jnp.asarray anywhere in the class
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        mutated = _subscript_mutated_self_attrs(cls)
+        if not mutated:
+            continue
+        for fn, qual in iter_functions(ast.Module(body=cls.body,
+                                                  type_ignores=[])):
+            qual = f"{cls.name}.{qual}"
+            for node in ast.walk(fn):
+                attr = _jnp_asarray_arg(node)
+                name = self_attr(attr) if attr is not None else None
+                if name in mutated and not mod.has_directive(node, "alias-ok"):
+                    out.append(mod.finding(
+                        "zero-copy-alias", node,
+                        f"jnp.asarray(self.{name}) may zero-copy alias a "
+                        f"mutable host buffer (self.{name} is subscript-"
+                        f"mutated elsewhere in {cls.name}); dispatch is "
+                        f"async — pass self.{name}.copy()",
+                        qual,
+                    ))
+
+    # function-local variant: jnp.asarray(v) where the SAME buffer
+    # generation of v (rebinding ``v = np.zeros(...)`` starts a new
+    # one) is subscript-mutated after the call, or persists across
+    # iterations of the loop the call sits in while being mutated
+    # there (each runtime iteration then writes into the buffer a
+    # previous iteration's async dispatch may still be reading)
+    for fn, qual in iter_functions(mod.tree):
+        muts: list[tuple[str, int, int, tuple[int, ...]]] = []
+        calls: list[tuple[str, int, ast.AST, tuple[int, ...]]] = []
+        state = {"gen": {}, "birth": {}}
+        _collect_local_alias_sites(fn, (), state, muts, calls)
+        for name, gen, node, loops in calls:
+            if mod.has_directive(node, "alias-ok"):
+                continue
+            birth = state["birth"].get((name, gen), ())
+            hazard = any(
+                m_name == name and m_gen == gen and (
+                    m_line > node.lineno
+                    or (loops and m_loops[:len(loops)] == loops
+                        and len(birth) < len(loops))
+                )
+                for m_name, m_gen, m_line, m_loops in muts
+            )
+            if hazard:
+                out.append(mod.finding(
+                    "zero-copy-alias", node,
+                    f"jnp.asarray({name}) may zero-copy alias {name!r}, "
+                    f"which is mutated while this dispatch can still be "
+                    f"in flight (async!) — snapshot with {name}.copy() "
+                    f"first",
+                    qual,
+                ))
+    return out
+
+
+def _jnp_asarray_arg(node: ast.AST) -> ast.AST | None:
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("asarray", "array")
+            and dotted(node.func.value) in _JNP and node.args):
+        return node.args[0]
+    return None
+
+
+def _subscript_mutated_self_attrs(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                name = self_attr(t.value)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _collect_local_alias_sites(node, loops, state, muts, calls):
+    """Walk one function in document order recording, per buffer
+    GENERATION, subscript mutations of local names and bare-name
+    jnp.asarray calls, each tagged with its loop stack.
+
+    A plain assignment to a bare name (``buf = np.zeros(...)``) starts
+    a new generation: mutations of the fresh buffer cannot touch memory
+    an earlier dispatch aliased. ``state`` carries ``gen`` (name ->
+    current generation) and ``birth`` ((name, gen) -> loop stack where
+    the generation was born); a generation born inside the same loop as
+    the call is fresh every iteration."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue  # separate scope
+        child_loops = loops
+        if isinstance(child, (ast.For, ast.While)):
+            child_loops = loops + (id(child),)
+        if isinstance(child, ast.Assign):
+            for t in child.targets:
+                for leaf in ([t] if not isinstance(t, (ast.Tuple, ast.List))
+                             else t.elts):
+                    if isinstance(leaf, ast.Name):
+                        g = state["gen"].get(leaf.id, 0) + 1
+                        state["gen"][leaf.id] = g
+                        state["birth"][(leaf.id, g)] = child_loops
+                    elif (isinstance(leaf, ast.Subscript)
+                          and isinstance(leaf.value, ast.Name)):
+                        n = leaf.value.id
+                        muts.append((n, state["gen"].get(n, 0),
+                                     child.lineno, child_loops))
+        elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+            t = child.target
+            if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                n = t.value.id
+                muts.append((n, state["gen"].get(n, 0),
+                             child.lineno, child_loops))
+            elif isinstance(t, ast.Name) and isinstance(child, ast.AugAssign):
+                # numpy ``buf += x`` mutates in place — a write, not a
+                # rebind
+                muts.append((t.id, state["gen"].get(t.id, 0),
+                             child.lineno, child_loops))
+            elif (isinstance(t, ast.Name) and isinstance(child, ast.AnnAssign)
+                  and child.value is not None):
+                g = state["gen"].get(t.id, 0) + 1
+                state["gen"][t.id] = g
+                state["birth"][(t.id, g)] = child_loops
+        arg = _jnp_asarray_arg(child)
+        if arg is not None and isinstance(arg, ast.Name):
+            calls.append((arg.id, state["gen"].get(arg.id, 0),
+                          child, child_loops))
+        _collect_local_alias_sites(child, child_loops, state, muts, calls)
+
+
+# -- rule 3: prng-reuse ---------------------------------------------------
+
+def check_prng_reuse(mod: ModuleInfo) -> list[Finding]:
+    """A jax PRNG key consumed by two sinks without an intervening
+    ``split``/``fold_in`` — the sampled-recovery bug class: drawing
+    twice from one key silently correlates streams (or, in replay,
+    re-draws a stream the original run already consumed)."""
+    out: list[Finding] = []
+    for fn, qual in iter_functions(mod.tree):
+        state: dict[str, dict] = {}
+        _prng_walk(fn.body, (), state, mod, qual, out)
+    return out
+
+
+def _track_key_targets(target, loops, state):
+    names = []
+    if isinstance(target, ast.Tuple):
+        names = [t for t in target.elts]
+    else:
+        names = [target]
+    for t in names:
+        name = dotted(t)
+        if name:
+            state[name] = {"used": None, "loops": loops}
+
+
+def _prng_walk(body, loops, state, mod, qual, out):
+    for node in body:
+        if isinstance(node, ast.Assign):
+            # unwrap indexing so `split(key, 2)[0]` still reads as a
+            # key-producing assignment
+            value = node.value
+            while isinstance(value, ast.Subscript):
+                value = value.value
+            cn = call_name(value) if isinstance(value, ast.Call) else None
+            if cn and cn.startswith("jax.random."):
+                for t in node.targets:
+                    _track_key_targets(t, loops, state)
+            else:
+                for t in node.targets:
+                    name = dotted(t)
+                    if name in state:
+                        del state[name]  # rebound to something else
+            _prng_visit_expr(node.value, loops, state, mod, qual, out)
+        elif isinstance(node, ast.If):
+            _prng_visit_expr(node.test, loops, state, mod, qual, out)
+            snap = {k: dict(v) for k, v in state.items()}
+            _prng_walk(node.body, loops, state, mod, qual, out)
+            merged = state.copy()
+            state.clear()
+            state.update(snap)
+            _prng_walk(node.orelse, loops, state, mod, qual, out)
+            for k, v in merged.items():  # a use on either branch counts
+                if k in state and v["used"] and not state[k]["used"]:
+                    state[k] = v
+        elif isinstance(node, (ast.For, ast.While)):
+            inner = loops + (id(node),)
+            if isinstance(node, ast.For):
+                _prng_visit_expr(node.iter, loops, state, mod, qual, out)
+            else:
+                _prng_visit_expr(node.test, loops, state, mod, qual, out)
+            _prng_walk(node.body, inner, state, mod, qual, out)
+            _prng_walk(node.orelse, loops, state, mod, qual, out)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            continue  # separate scope (iter_functions visits it)
+        elif isinstance(node, ast.Try):
+            _prng_walk(node.body, loops, state, mod, qual, out)
+            for h in node.handlers:
+                _prng_walk(h.body, loops, state, mod, qual, out)
+            _prng_walk(node.orelse, loops, state, mod, qual, out)
+            _prng_walk(node.finalbody, loops, state, mod, qual, out)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                _prng_visit_expr(item.context_expr, loops, state, mod,
+                                 qual, out)
+            _prng_walk(node.body, loops, state, mod, qual, out)
+        else:
+            for value in ast.iter_child_nodes(node):
+                _prng_visit_expr(value, loops, state, mod, qual, out)
+
+
+def _prng_visit_expr(expr, loops, state, mod, qual, out):
+    if expr is None:
+        return
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        if cn is None:
+            continue
+        is_random = cn.startswith("jax.random.")
+        leaf = cn.rsplit(".", 1)[-1]
+        if is_random and leaf in _KEY_DERIVERS:
+            continue  # split/fold_in/key_data derive, never consume
+        for arg in node.args:
+            name = dotted(arg)
+            entry = state.get(name) if name else None
+            if entry is None:
+                continue
+            if mod.has_directive(node, "prng-ok"):
+                continue
+            if entry["used"] is not None:
+                out.append(mod.finding(
+                    "prng-reuse", node,
+                    f"PRNG key {name!r} consumed again (first sink at "
+                    f"line {entry['used']}) without an intervening "
+                    f"split/fold_in — streams will correlate",
+                    qual,
+                ))
+            elif loops and entry["loops"][:len(loops)] != loops:
+                out.append(mod.finding(
+                    "prng-reuse", node,
+                    f"PRNG key {name!r} consumed inside a loop but "
+                    f"derived outside it — every iteration draws the "
+                    f"same stream; split/fold_in per iteration",
+                    qual,
+                ))
+                entry["used"] = node.lineno
+            else:
+                entry["used"] = node.lineno
+
+
+# -- rule 4: lock-discipline ----------------------------------------------
+
+def check_lock_discipline(mod: ModuleInfo) -> list[Finding]:
+    """Accesses to ``# guarded-by: <lock>`` attributes outside a
+    lexical ``with ...<lock>:`` block. ``__init__`` bodies are exempt
+    (construction precedes sharing); ``# lint: holds <lock>`` on a def
+    marks a helper whose callers all hold the lock."""
+    guarded: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for ln in mod.span_lines(node):
+                lock = mod.guarded_lines.get(ln)
+                if lock is None:
+                    continue
+                for t in targets:
+                    name = self_attr(t)
+                    if name:
+                        guarded[name] = lock
+    if not guarded:
+        return []
+
+    out: list[Finding] = []
+
+    def walk(node, held: frozenset[str], qual: str, in_init: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                h = mod.def_directive(child, "holds")
+                child_held = frozenset([h] if h else [])
+                walk(child, child_held, q, child.name == "__init__")
+                continue
+            if isinstance(child, ast.Lambda):
+                walk(child, frozenset(), qual, False)
+                continue
+            if isinstance(child, ast.ClassDef):
+                walk(child, frozenset(), f"{qual}.{child.name}".lstrip("."),
+                     False)
+                continue
+            child_held = held
+            if isinstance(child, ast.With):
+                names = set()
+                for item in child.items:
+                    d = dotted(item.context_expr)
+                    if d:
+                        names.add(d.rsplit(".", 1)[-1])
+                child_held = held | names
+            if isinstance(child, ast.Attribute):
+                lock = guarded.get(child.attr)
+                if (lock is not None and not in_init
+                        and lock not in child_held
+                        and not mod.has_directive(child, "lock-ok")
+                        and child.lineno not in mod.guarded_lines):
+                    out.append(mod.finding(
+                        "lock-discipline", child,
+                        f".{child.attr} is '# guarded-by: {lock}' but "
+                        f"accessed outside a 'with ...{lock}:' block "
+                        f"(in {qual or '<module>'})",
+                        qual or "<module>",
+                    ))
+            walk(child, child_held, qual, in_init)
+
+    walk(mod.tree, frozenset(), "", False)
+    return out
+
+
+# -- rule 5: retrace-hazard -----------------------------------------------
+
+def check_retrace_hazard(mod: ModuleInfo) -> list[Finding]:
+    """``jax.jit`` used in a way that defeats its trace cache: invoked
+    immediately at a call site (``jax.jit(f)(x)``) outside
+    construction, or created inside a loop. Each such site compiles a
+    fresh program per call when the wrapped function's identity varies
+    — the compile-count bounds the serving engine guarantees
+    (O(log max_len) prefill programs, one step program per horizon)
+    depend on every jit being cached in a keyed family. The runtime
+    complement is ``CompileCountGuard``."""
+    out: list[Finding] = []
+    for fn, qual in iter_functions(mod.tree):
+        if fn.name == "__init__":
+            continue  # one-time construction cost, not a retrace
+        _retrace_walk(fn, (), mod, qual, out)
+    return out
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) in ("jax.jit",
+                                                              "jit")
+
+
+def _retrace_walk(node, loops, mod, qual, out):
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested defs visited by iter_functions
+        child_loops = loops
+        if isinstance(child, (ast.For, ast.While)):
+            child_loops = loops + (id(child),)
+        if isinstance(child, ast.Call):
+            if _is_jit_call(child.func):
+                # jax.jit(f)(x): immediate invocation — jit's cache is
+                # keyed on f's identity, which a local/lambda renews
+                # per call
+                if not mod.has_directive(child, "retrace-ok"):
+                    out.append(mod.finding(
+                        "retrace-hazard", child,
+                        f"jax.jit(...)(...) invoked immediately in "
+                        f"{qual!r}: the compiled program is rebuilt "
+                        f"whenever the wrapped function's identity "
+                        f"varies — cache the jitted callable (or "
+                        f"annotate '# lint: retrace-ok <reason>')",
+                        qual,
+                    ))
+            elif _is_jit_call(child) and child_loops:
+                if not mod.has_directive(child, "retrace-ok"):
+                    out.append(mod.finding(
+                        "retrace-hazard", child,
+                        f"jax.jit created inside a loop in {qual!r}: "
+                        f"hoist it out (or annotate "
+                        f"'# lint: retrace-ok <reason>')",
+                        qual,
+                    ))
+        _retrace_walk(child, child_loops, mod, qual, out)
+
+
+# -- registry -------------------------------------------------------------
+
+RULES = {
+    "host-sync": check_host_sync,
+    "zero-copy-alias": check_zero_copy_alias,
+    "prng-reuse": check_prng_reuse,
+    "lock-discipline": check_lock_discipline,
+    "retrace-hazard": check_retrace_hazard,
+}
+
+
+def run_rules(mod: ModuleInfo, rules=None) -> list[Finding]:
+    out: list[Finding] = []
+    for name in (rules or RULES):
+        out.extend(RULES[name](mod))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

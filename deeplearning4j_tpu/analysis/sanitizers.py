@@ -1,0 +1,546 @@
+"""Opt-in runtime sanitizers for the serving stack.
+
+Three detectors, each targeting a bug class this repo has actually
+hit, all ZERO-COST when off (the same bar as the obs tracer: the
+disabled path is one module-global ``None`` check, and nothing is
+wrapped or patched unless a sanitizer is installed):
+
+- :class:`LockSanitizer` — Eraser/ThreadSanitizer-style lockset
+  tracking plus lock-order cycle detection across the engine / HTTP /
+  metrics / health threads. Serving modules create their locks through
+  :func:`wrap_lock`, which is the identity while no sanitizer is
+  installed and returns an instrumented proxy while one is; writes to
+  shared structures report through :func:`note_access` and are checked
+  with a single-writer lockset discipline (two writer threads with no
+  common lock -> violation; GIL-atomic single-writer/multi-reader
+  patterns are deliberately NOT flagged).
+- :class:`SyncSanitizer` — counts blocking device->host syncs per
+  engine phase by patching ``numpy.asarray``/``numpy.array`` (the
+  repo's readback convention) while installed, with per-phase budgets:
+  zero inside the dispatch critical section, one designated readback
+  per horizon in the process phase. Also carries the zero-copy-alias
+  tripwire: the engine registers the exact host buffer each dispatch
+  consumed (:meth:`SyncSanitizer.track`) and the readback verifies the
+  bytes did not change while the program was in flight.
+- :class:`CompileCountGuard` — asserts the engine's compile-count
+  contracts after (or during) a serve run: prefill/chunk programs stay
+  within the O(log max_len) power-of-two bucket family, step programs
+  within {1, K}, batched-admission programs within the
+  (bucket, pow2-group) grid. This is the dynamic complement of the
+  static ``retrace-hazard`` rule.
+
+Nothing here imports jax or numpy at module level — detection is by
+``sys.modules`` lookup — so importing this module (which every serving
+module does for ``wrap_lock``) adds no dependency weight.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import sys
+import threading
+import traceback
+
+_log = logging.getLogger(__name__)
+
+#: the installed sanitizers (module globals so the disabled-path check
+#: at call sites is a single load + None test)
+_ACTIVE_LOCK: "LockSanitizer | None" = None
+_ACTIVE_SYNC: "SyncSanitizer | None" = None
+
+#: cap per sanitizer so a hot violation site cannot grow memory
+_MAX_VIOLATIONS = 200
+
+
+class SanitizerViolation(AssertionError):
+    """Raised by ``assert_clean``/``assert_ok`` when a sanitizer
+    recorded violations."""
+
+
+def _is_jax_array(x) -> bool:
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(x, jax.Array)
+
+
+def _caller() -> str:
+    """file:line of the frame that triggered a detector (skipping
+    sanitizer frames) — enough context to find the site, cheap enough
+    to compute only on violation."""
+    for frame in reversed(traceback.extract_stack(limit=12)[:-2]):
+        if "analysis/sanitizers" not in frame.filename.replace("\\", "/"):
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+# -------------------------------------------------------------------- #
+# LockSanitizer                                                        #
+# -------------------------------------------------------------------- #
+
+
+class _SanLock:
+    """Lock proxy recording acquisition order and per-thread locksets.
+    Delegates everything to the wrapped lock, so semantics (blocking,
+    timeouts, ``with``) are unchanged."""
+
+    __slots__ = ("_san", "_lock", "name")
+
+    def __init__(self, san: "LockSanitizer", lock, name: str):
+        self._san = san
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._san._before_acquire(self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._san._on_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        self._san._on_release(self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class LockSanitizer:
+    """Lockset tracking + lock-order cycle detection.
+
+    ``install()`` makes :func:`wrap_lock` return instrumented proxies
+    for locks created from then on (the serving stack creates its
+    locks at construction, so install BEFORE building the engine/
+    server/router). The order graph records an edge A->B whenever B is
+    acquired while A is held; acquiring in an order that closes a
+    cycle is reported immediately — a potential deadlock, caught
+    without needing the interleaving that would actually deadlock.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()  # guards graph/violations/access
+        self._tls = threading.local()
+        self._edges: dict[str, set[str]] = {}
+        self._access: dict[str, dict] = {}
+        self.violations: list[str] = []
+        self.n_wrapped = 0
+
+    # -- lifecycle ----------------------------------------------------
+
+    def install(self) -> "LockSanitizer":
+        global _ACTIVE_LOCK
+        _ACTIVE_LOCK = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE_LOCK
+        if _ACTIVE_LOCK is self:
+            _ACTIVE_LOCK = None
+
+    def __enter__(self) -> "LockSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- wrapping -----------------------------------------------------
+
+    def wrap(self, lock, name: str) -> _SanLock:
+        self.n_wrapped += 1
+        return _SanLock(self, lock, name)
+
+    def _held(self) -> list[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _before_acquire(self, name: str) -> None:
+        """Order check happens BEFORE blocking on the lock, so a cycle
+        is reported even when the acquisition would deadlock."""
+        held = self._held()
+        if not held:
+            return
+        with self._mu:
+            for h in held:
+                if h == name:
+                    continue
+                edges = self._edges.setdefault(h, set())
+                if name in edges:
+                    continue
+                edges.add(name)
+                if self._reaches(name, h):
+                    self._violate(
+                        f"lock-order inversion: acquiring {name!r} while "
+                        f"holding {h!r} at {_caller()}, but the opposite "
+                        f"order {name!r} -> ... -> {h!r} was observed "
+                        f"earlier — potential deadlock"
+                    )
+
+    def _on_acquire(self, name: str) -> None:
+        self._held().append(name)
+
+    def _on_release(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(self._edges.get(n, ()))
+        return False
+
+    # -- shared-write discipline (Eraser lockset, single-writer) ------
+
+    def note_access(self, key: str, write: bool = False) -> None:
+        """Report an access to a named shared structure. Only writes
+        are checked: two writer THREADS with an empty common lockset is
+        a violation; single-writer/multi-reader under the GIL is not
+        (flagging it would drown the report in benign races this
+        codebase relies on)."""
+        if not write:
+            return
+        held = frozenset(self._held())
+        tid = threading.get_ident()
+        with self._mu:
+            e = self._access.setdefault(
+                key, {"lockset": None, "writers": {}, "reported": False}
+            )
+            e["writers"][tid] = threading.current_thread().name
+            e["lockset"] = (set(held) if e["lockset"] is None
+                            else e["lockset"] & held)
+            if (len(e["writers"]) >= 2 and not e["lockset"]
+                    and not e["reported"]):
+                e["reported"] = True
+                self._violate(
+                    f"unlocked write race on {key!r}: written by threads "
+                    f"{sorted(e['writers'].values())} with no common lock "
+                    f"held (last write at {_caller()})"
+                )
+
+    # -- reporting ----------------------------------------------------
+
+    def _violate(self, msg: str) -> None:
+        if len(self.violations) < _MAX_VIOLATIONS:
+            self.violations.append(msg)
+        _log.error("LockSanitizer: %s", msg)
+
+    def lock_order_edges(self) -> dict[str, set[str]]:
+        with self._mu:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    def report(self) -> str:
+        return "\n".join(self.violations) or "LockSanitizer: clean"
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            raise SanitizerViolation(self.report())
+
+
+# -------------------------------------------------------------------- #
+# SyncSanitizer                                                        #
+# -------------------------------------------------------------------- #
+
+
+class SyncSanitizer:
+    """Count blocking device->host syncs per engine phase, enforce
+    budgets, and verify dispatch-aliased host buffers stay immutable
+    while their program is in flight.
+
+    ``install()`` patches ``numpy.asarray``/``numpy.array`` with a
+    wrapper that notes calls whose first argument is a jax array (the
+    blocking-sync signature this repo uses for readback) and attributes
+    them to the current thread's engine phase (set by the engine via
+    :meth:`set_phase` when a sanitizer is attached). ``uninstall()``
+    restores the pristine functions. Budgets: a phase mapped to ``N``
+    tolerates at most N syncs for the sanitizer's lifetime; unmapped
+    phases are counted but unbudgeted (tests assert on
+    :meth:`sync_count`). Default budget: ``{"dispatch": 0}`` — the
+    critical section must never block.
+    """
+
+    def __init__(self, budgets: dict[str, int] | None = None):
+        self.budgets = dict(budgets) if budgets is not None else {
+            "dispatch": 0,
+        }
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self.counts: dict[str, int] = {}
+        self.violations: list[str] = []
+        self._tracked: dict[str, list] = {}  # name -> FIFO of (buf, snapshot)
+        self._orig: tuple | None = None
+        self.active = False
+
+    # -- lifecycle ----------------------------------------------------
+
+    def install(self) -> "SyncSanitizer":
+        global _ACTIVE_SYNC
+        np = sys.modules.get("numpy")
+        if np is None:  # pragma: no cover - numpy is always loaded here
+            raise RuntimeError("numpy not imported; nothing to patch")
+        if self._orig is None:
+            orig_asarray, orig_array = np.asarray, np.array
+            san = self
+
+            def asarray(a, *args, **kw):
+                san._note(a)
+                return orig_asarray(a, *args, **kw)
+
+            def array(a, *args, **kw):
+                san._note(a)
+                return orig_array(a, *args, **kw)
+
+            self._orig = (np, orig_asarray, orig_array)
+            np.asarray, np.array = asarray, array
+        self.active = True
+        _ACTIVE_SYNC = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE_SYNC
+        self.active = False
+        if self._orig is not None:
+            np, orig_asarray, orig_array = self._orig
+            np.asarray, np.array = orig_asarray, orig_array
+            self._orig = None
+        if _ACTIVE_SYNC is self:
+            _ACTIVE_SYNC = None
+
+    def __enter__(self) -> "SyncSanitizer":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- phase + counting ---------------------------------------------
+
+    def set_phase(self, phase: str | None) -> None:
+        self._tls.phase = phase
+
+    @property
+    def phase(self) -> str | None:
+        return getattr(self._tls, "phase", None)
+
+    def _note(self, a) -> None:
+        if not self.active or getattr(self._tls, "busy", False):
+            return
+        if not _is_jax_array(a):
+            return
+        # re-entrancy guard: materializing a jax array can itself call
+        # np.asarray internally
+        self._tls.busy = True
+        try:
+            ph = self.phase or "unphased"
+            with self._mu:
+                n = self.counts.get(ph, 0) + 1
+                self.counts[ph] = n
+                budget = self.budgets.get(ph)
+            if budget is not None and n > budget:
+                self._violate(
+                    f"blocking device->host sync in phase {ph!r} at "
+                    f"{_caller()}: count {n} exceeds budget {budget}"
+                )
+        finally:
+            self._tls.busy = False
+
+    def sync_count(self, phase: str) -> int:
+        return self.counts.get(phase, 0)
+
+    # -- zero-copy-alias tripwire -------------------------------------
+
+    def track(self, name: str, buf) -> None:
+        """Register the exact host buffer an async dispatch consumed;
+        :meth:`check` at the readback verifies it was not mutated while
+        the program was in flight (if it was, and ``jnp.asarray`` had
+        zero-copy aliased it, the program read torn data — the PR-2
+        race). Entries queue FIFO per name: with pipelined horizons the
+        NEXT dispatch is tracked before the previous readback checks,
+        so check() always pops the oldest outstanding dispatch. The
+        queue is bounded — crash recovery can drop an in-flight horizon
+        without ever processing it."""
+        q = self._tracked.setdefault(name, [])
+        q.append((buf, buf.tobytes()))
+        del q[:-8]
+
+    def check(self, name: str | None = None) -> None:
+        names = [name] if name is not None else list(self._tracked)
+        for n in names:
+            q = self._tracked.get(n)
+            if not q:
+                continue
+            buf, snap = q.pop(0)
+            if buf.tobytes() != snap:
+                self._violate(
+                    f"dispatch-aliased host buffer {n!r} mutated while "
+                    f"its program was in flight — zero-copy aliasing "
+                    f"race (snapshot the buffer with .copy() before "
+                    f"dispatch)"
+                )
+
+    # -- reporting ----------------------------------------------------
+
+    def _violate(self, msg: str) -> None:
+        if len(self.violations) < _MAX_VIOLATIONS:
+            self.violations.append(msg)
+        _log.error("SyncSanitizer: %s", msg)
+
+    def assert_budgets(self) -> None:
+        over = [
+            f"phase {ph!r}: {self.counts.get(ph, 0)} > budget {b}"
+            for ph, b in self.budgets.items()
+            if self.counts.get(ph, 0) > b
+        ]
+        if over:
+            raise SanitizerViolation("sync budgets exceeded: "
+                                     + "; ".join(over))
+
+    def report(self) -> str:
+        lines = [f"sync counts: {dict(sorted(self.counts.items()))}"]
+        lines += self.violations
+        return "\n".join(lines)
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            raise SanitizerViolation(self.report())
+
+
+# -------------------------------------------------------------------- #
+# CompileCountGuard                                                    #
+# -------------------------------------------------------------------- #
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+class CompileCountGuard:
+    """Assert the engine's compile-count contracts.
+
+    The engine's jit stability story is that traffic shape can never
+    grow the program cache beyond fixed families: prefill and chunk
+    programs live on the power-of-two bucket grid (O(log max_len) of
+    them), fused step programs on {1, K} (adaptive horizon), batched
+    admission programs on (bucket, pow2 group size). A regression that
+    keys a program on a request-varying value (the retrace-hazard bug
+    class) shows up here as an out-of-family key or unbounded growth.
+
+    Use as a context manager around a serve run, or call
+    :meth:`check`/:meth:`assert_ok` directly.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.violations: list[str] = []
+
+    def _allowed_buckets(self) -> set[int]:
+        eng = self.engine
+        b, out = eng._min_bucket, set()
+        while b <= eng._max_bucket:
+            out.add(b)
+            b *= 2
+        return out
+
+    def check(self) -> list[str]:
+        eng = self.engine
+        v: list[str] = []
+        buckets = self._allowed_buckets()
+        log_bound = int(math.log2(eng._max_bucket)) + 1
+        for label, fns in (("prefill", eng._prefill_fns),
+                           ("chunk", eng._chunk_fns)):
+            keys = set(fns)
+            if not keys <= buckets:
+                v.append(
+                    f"{label} programs keyed outside the pow2 bucket "
+                    f"family {sorted(buckets)}: {sorted(keys - buckets)}"
+                )
+            if len(keys) > log_bound:
+                v.append(
+                    f"{label} program count {len(keys)} exceeds the "
+                    f"O(log max_len) bound {log_bound}"
+                )
+        step_allowed = {1, eng.decode_horizon}
+        if not set(eng._step_fns) <= step_allowed:
+            v.append(
+                f"step programs keyed outside {sorted(step_allowed)}: "
+                f"{sorted(set(eng._step_fns) - step_allowed)}"
+            )
+        for label, fns in (("batch-prefill", eng._batch_prefill_fns),
+                           ("batch-hit", eng._batch_hit_fns)):
+            bad = [
+                k for k in fns
+                if not (k[0] in buckets and _is_pow2(k[1])
+                        and k[1] <= eng.n_slots)
+            ]
+            if bad:
+                v.append(
+                    f"{label} programs keyed outside the "
+                    f"(bucket, pow2 group <= n_slots) grid: {sorted(bad)}"
+                )
+        self.violations = v
+        return v
+
+    def assert_ok(self) -> None:
+        if self.check():
+            raise SanitizerViolation(
+                "compile-count contract broken: "
+                + "; ".join(self.violations)
+            )
+
+    def __enter__(self) -> "CompileCountGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.assert_ok()
+        return False
+
+
+# -------------------------------------------------------------------- #
+# module-level hooks (the zero-cost-when-off seam)                     #
+# -------------------------------------------------------------------- #
+
+
+def wrap_lock(lock, name: str):
+    """Identity while no :class:`LockSanitizer` is installed (the
+    default, production path); an instrumented proxy while one is.
+    Serving modules create every cross-thread lock through this."""
+    san = _ACTIVE_LOCK
+    if san is None:
+        return lock
+    return san.wrap(lock, name)
+
+
+def note_access(key: str, write: bool = False) -> None:
+    """Report a shared-structure access to the installed
+    :class:`LockSanitizer`; no-op (one global None check) when none
+    is."""
+    san = _ACTIVE_LOCK
+    if san is not None:
+        san.note_access(key, write=write)
+
+
+def lock_sanitizer() -> LockSanitizer | None:
+    return _ACTIVE_LOCK
+
+
+def sync_sanitizer() -> SyncSanitizer | None:
+    return _ACTIVE_SYNC

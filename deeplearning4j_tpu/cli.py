@@ -491,6 +491,17 @@ def cmd_serve(args) -> int:
     probe_cache = None
     if args.probe_cache and args.probe_cache.lower() not in ("off", "none"):
         probe_cache = os.path.expanduser(args.probe_cache)
+    sans = None
+    if args.sanitize:
+        from deeplearning4j_tpu.analysis.sanitizers import (
+            LockSanitizer,
+            SyncSanitizer,
+        )
+
+        # install BEFORE the engine/server/router build their locks:
+        # wrap_lock only instruments locks created while active
+        sans = (LockSanitizer().install(), SyncSanitizer().install())
+        print("sanitizers: lock + sync active (development mode)")
     engine = ServingEngine(
         cfg, params,
         n_slots=args.slots,
@@ -514,6 +525,8 @@ def cmd_serve(args) -> int:
             args.tp_parity],
         probe_cache=probe_cache,
     )
+    if sans is not None:
+        engine.attach_sanitizer(sans[1])
     if args.tp > 1:
         if engine.tp == args.tp:
             print(f"tensor parallel: decode sharded over {engine.tp} "
@@ -570,6 +583,34 @@ def cmd_serve(args) -> int:
             out = tracer.export(args.trace_out)
             print(f"trace: {tracer.n_events} events "
                   f"({tracer.dropped} dropped) -> {out}")
+    if sans is not None:
+        return _report_sanitizers(engine, *sans)
+    return 0
+
+
+def _report_sanitizers(engine, lock_san, sync_san) -> int:
+    """Uninstall the serve-mode sanitizers, run the compile-count
+    guard, print one summary line per detector, and return 1 when any
+    violation was recorded."""
+    from deeplearning4j_tpu.analysis.sanitizers import CompileCountGuard
+
+    sync_san.uninstall()
+    lock_san.uninstall()
+    compile_viol = CompileCountGuard(engine).check()
+    print(f"sanitizers: {lock_san.n_wrapped} locks tracked, "
+          f"sync counts {dict(sorted(sync_san.counts.items()))}")
+    violations = (
+        [f"[lock] {m}" for m in lock_san.violations]
+        + [f"[sync] {m}" for m in sync_san.violations]
+        + [f"[compile] {m}" for m in compile_viol]
+    )
+    for msg in violations:
+        print(f"sanitizer violation: {msg}", file=sys.stderr)
+    if violations:
+        print(f"sanitizers: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("sanitizers: clean")
     return 0
 
 
@@ -583,6 +624,28 @@ def _write_port_file(path: str, server) -> None:
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
+
+
+def cmd_lint(args) -> int:
+    """Static analysis for this repo's proven serving bug classes
+    (host-sync, zero-copy-alias, prng-reuse, lock-discipline,
+    retrace-hazard). Pure stdlib — never imports the linted code.
+    Exits 1 on findings not accepted in the baseline
+    (.graftlint.json); see README "Correctness tooling"."""
+    from deeplearning4j_tpu.analysis import lint as graftlint
+
+    argv = list(args.paths)
+    if args.rules:
+        argv += ["--rules", args.rules]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.strict:
+        argv.append("--strict")
+    return graftlint.main(argv)
 
 
 def cmd_router(args) -> int:
@@ -854,6 +917,12 @@ def main(argv: list[str] | None = None) -> int:
                    "at this per-step probability (smoke-tests the "
                    "supervised retry/replay path; see serving/faults.py)")
     v.add_argument("--chaos-seed", type=int, default=0)
+    v.add_argument("--sanitize", action="store_true",
+                   help="development mode: enable the runtime "
+                   "sanitizers (lock-order + lockset tracking, "
+                   "per-phase blocking-sync budgets, dispatch-alias "
+                   "integrity, compile-count bounds) and exit nonzero "
+                   "if any fires; see README 'Correctness tooling'")
     v.add_argument("--trace-out", default=None, metavar="PATH",
                    help="enable the request-lifecycle tracer and write "
                    "a Chrome-trace/Perfetto JSON of the ring-buffered "
@@ -941,6 +1010,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="write the bound address as JSON to PATH once "
                    "listening (for harnesses using --port 0)")
     r.set_defaults(fn=cmd_router)
+
+    L = sub.add_parser(
+        "lint",
+        help="static analysis for the serving stack's proven bug "
+        "classes (graftlint); exits 1 on non-baselined findings",
+    )
+    L.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the installed "
+                   "deeplearning4j_tpu package)")
+    L.add_argument("--rules", default=None, metavar="R1,R2",
+                   help="comma-separated rule subset")
+    L.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline JSON (default: .graftlint.json at "
+                   "the repo root)")
+    L.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    L.add_argument("--write-baseline", action="store_true",
+                   help="accept the current findings into the baseline")
+    L.add_argument("--strict", action="store_true",
+                   help="also fail on stale baseline entries and TODO "
+                   "reasons (CI mode)")
+    L.set_defaults(fn=cmd_lint)
 
     # add_help=False so `bench -h` reaches bench.py's parser, which
     # documents --model/--batch/--dtype

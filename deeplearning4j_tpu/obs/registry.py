@@ -17,10 +17,15 @@ created with ``labelnames`` and each operation passes the label
 Metric/label names are validated against the Prometheus grammar at
 creation so a typo fails at wiring time, not at scrape time.
 
-Thread-safety: instrument updates take a per-instrument lock (the
-serving engine thread and HTTP handler threads both record);
-``render`` reads without one — a scrape may straddle an update, which
-Prometheus semantics allow (monotonic counters never go backwards).
+Thread-safety: instrument updates AND reads take a per-instrument
+lock (the serving engine thread and HTTP handler threads both record
+while the metrics sidecar scrapes): a scrape straddling an update is
+fine under Prometheus semantics, but an unlocked read iterating the
+label-set dict while a first-time label set inserts is not — that is a
+"dict changed size during iteration" crash in the scrape handler.
+Readers snapshot under the lock and render outside it, so gauge
+callbacks (which reach into pool/scheduler state behind their own
+locks) never run with an instrument lock held.
 """
 
 from __future__ import annotations
@@ -29,6 +34,8 @@ import math
 import random
 import re
 import threading
+
+from deeplearning4j_tpu.analysis.sanitizers import wrap_lock
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -87,7 +94,7 @@ class _Instrument:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = wrap_lock(threading.Lock(), f"metrics.{name}")
 
     def _header(self) -> list[str]:
         return [
@@ -103,7 +110,7 @@ class Counter(_Instrument):
 
     def __init__(self, name, help, labelnames=()):
         super().__init__(name, help, labelnames)
-        self._values: dict[tuple, float] = {}
+        self._values: dict[tuple, float] = {}  # guarded-by: _lock
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         if amount < 0:
@@ -113,11 +120,14 @@ class Counter(_Instrument):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(_labelset(self.labelnames, labels), 0.0)
+        with self._lock:
+            return self._values.get(_labelset(self.labelnames, labels), 0.0)
 
     def render(self) -> list[str]:
         out = self._header()
-        values = self._values or ({(): 0.0} if not self.labelnames else {})
+        with self._lock:
+            values = dict(self._values)
+        values = values or ({(): 0.0} if not self.labelnames else {})
         for key in sorted(values):
             out.append(
                 f"{self.name}{_render_labels(self.labelnames, key)} "
@@ -135,7 +145,7 @@ class Gauge(_Instrument):
 
     def __init__(self, name, help, labelnames=()):
         super().__init__(name, help, labelnames)
-        self._values: dict[tuple, float] = {}
+        self._values: dict[tuple, float] = {}  # guarded-by: _lock
         self._fn = None
 
     def set(self, value: float, **labels) -> None:
@@ -159,18 +169,23 @@ class Gauge(_Instrument):
     def value(self, **labels) -> float:
         if self._fn is not None:
             return float(self._fn())
-        return self._values.get(_labelset(self.labelnames, labels), 0.0)
+        with self._lock:
+            return self._values.get(_labelset(self.labelnames, labels), 0.0)
 
     def render(self) -> list[str]:
         out = self._header()
         if self._fn is not None:
+            # callback path: evaluated with NO lock held — callbacks
+            # read pool/scheduler state behind their own locks
             try:
                 v = float(self._fn())
             except Exception:
                 v = math.nan  # a dead callback must not kill the scrape
             out.append(f"{self.name} {_fmt(v)}")
             return out
-        values = self._values or ({(): 0.0} if not self.labelnames else {})
+        with self._lock:
+            values = dict(self._values)
+        values = values or ({(): 0.0} if not self.labelnames else {})
         for key in sorted(values):
             out.append(
                 f"{self.name}{_render_labels(self.labelnames, key)} "
@@ -193,8 +208,9 @@ class Histogram(_Instrument):
         if not bs:
             raise ValueError("need at least one bucket bound")
         self.buckets = bs
-        self._counts: dict[tuple, list[int]] = {}  # +1 slot for +Inf
-        self._sum: dict[tuple, float] = {}
+        # +1 count slot for +Inf; guarded-by: _lock
+        self._counts: dict[tuple, list[int]] = {}  # guarded-by: _lock
+        self._sum: dict[tuple, float] = {}  # guarded-by: _lock
 
     def observe(self, value: float, **labels) -> None:
         key = _labelset(self.labelnames, labels)
@@ -216,11 +232,15 @@ class Histogram(_Instrument):
 
     def count(self, **labels) -> int:
         key = _labelset(self.labelnames, labels)
-        return sum(self._counts.get(key, ()))
+        with self._lock:
+            return sum(self._counts.get(key, ()))
 
     def render(self) -> list[str]:
         out = self._header()
-        counts = self._counts or (
+        with self._lock:
+            counts = {k: list(v) for k, v in self._counts.items()}
+            sums = dict(self._sum)
+        counts = counts or (
             {(): [0] * (len(self.buckets) + 1)} if not self.labelnames
             else {}
         )
@@ -237,7 +257,7 @@ class Histogram(_Instrument):
             out.append(f"{self.name}_bucket{lbl} {cum}")
             plain = _render_labels(self.labelnames, key)
             out.append(
-                f"{self.name}_sum{plain} {_fmt(self._sum.get(key, 0.0))}"
+                f"{self.name}_sum{plain} {_fmt(sums.get(key, 0.0))}"
             )
             out.append(f"{self.name}_count{plain} {cum}")
         return out
@@ -250,8 +270,8 @@ class MetricsRegistry:
     mismatch on an existing name raises — that is a bug, not a race)."""
 
     def __init__(self):
-        self._instruments: dict[str, _Instrument] = {}
-        self._lock = threading.Lock()
+        self._instruments: dict[str, _Instrument] = {}  # guarded-by: _lock
+        self._lock = wrap_lock(threading.Lock(), "metrics.registry")
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):
         with self._lock:
@@ -279,13 +299,20 @@ class MetricsRegistry:
         )
 
     def get(self, name) -> _Instrument | None:
-        return self._instruments.get(name)
+        with self._lock:
+            return self._instruments.get(name)
 
     def render(self) -> str:
-        """The whole registry in Prometheus text exposition format."""
+        """The whole registry in Prometheus text exposition format.
+        The instrument list is snapshotted under the registry lock and
+        rendered outside it (per-instrument locks and gauge callbacks
+        must not nest under it)."""
+        with self._lock:
+            insts = [self._instruments[n]
+                     for n in sorted(self._instruments)]
         lines = []
-        for name in sorted(self._instruments):
-            lines.extend(self._instruments[name].render())
+        for inst in insts:
+            lines.extend(inst.render())
         return "\n".join(lines) + "\n"
 
 

@@ -229,7 +229,9 @@ def make_step(conf: LayerConfig, model: ModelFunctions, algo: str | None = None)
             new_score = model.score(new_params, k_score)
 
         if post_fn is not None:
-            _, new_raw_grad = model.score_and_grad(new_params, k_grad)
+            # the curvature pair g(new)-g(old) wants correlated sampling,
+            # so the second eval reuses k_grad on purpose
+            _, new_raw_grad = model.score_and_grad(new_params, k_grad)  # lint: prng-ok correlated curvature pair
             extra = post_fn(extra, new_params, new_raw_grad)
 
         grad_norm = tm.norm2(raw_grad)

@@ -25,8 +25,11 @@ from __future__ import annotations
 
 import heapq
 import math
+import threading
 
 import jax
+
+from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
 
 from deeplearning4j_tpu.models.transformer import (
     TransformerConfig,
@@ -54,13 +57,18 @@ class KVSlotPool:
         kv = self.caches["kv"] if isinstance(self.caches, dict) else self.caches
         self.n_slots = n_slots
         self.tpad = kv.shape[3]  # rounded-up row count per slot
-        self._free = list(range(n_slots))  # already a heap
-        self._in_use: set[int] = set()
+        # acquire/release/generation run on the engine thread while
+        # n_free/n_active/occupancy feed metrics gauges scraped from
+        # the sidecar thread — free-list bookkeeping moves under the
+        # lock so a scrape never sees the heap mid-rebalance
+        self._lock = wrap_lock(threading.Lock(), "pool._lock")
+        self._free = list(range(n_slots))  # already a heap; guarded-by: _lock
+        self._in_use: set[int] = set()  # guarded-by: _lock
         # per-slot generation, bumped on acquire: with pipelined
         # readback a token block can arrive for a slot that was retired
         # and re-acquired after its dispatch — the generation lets the
         # engine tell the block belongs to the previous occupant
-        self._gen = [0] * n_slots
+        self._gen = [0] * n_slots  # guarded-by: _lock
         # byte sizes captured ONCE at allocation time (shape/dtype are
         # host metadata): metrics scrapes must never walk the live
         # device pytree (under donation a buffer can be
@@ -82,36 +90,44 @@ class KVSlotPool:
 
     @property
     def n_free(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     @property
     def n_active(self) -> int:
-        return len(self._in_use)
+        with self._lock:
+            return len(self._in_use)
 
     @property
     def occupancy(self) -> float:
         """Active fraction of the slot batch this instant, in [0, 1]."""
-        return len(self._in_use) / self.n_slots
+        with self._lock:
+            return len(self._in_use) / self.n_slots
 
     def acquire(self) -> int:
         """Claim the lowest free slot index."""
-        if not self._free:
-            raise RuntimeError("no free KV slots")
-        slot = heapq.heappop(self._free)
-        self._in_use.add(slot)
-        self._gen[slot] += 1
-        return slot
+        with self._lock:
+            note_access("pool.freelist", write=True)
+            if not self._free:
+                raise RuntimeError("no free KV slots")
+            slot = heapq.heappop(self._free)
+            self._in_use.add(slot)
+            self._gen[slot] += 1
+            return slot
 
     def generation(self, slot: int) -> int:
         """Acquire count for ``slot`` — identifies the current occupant
         across release/re-acquire (see ``_gen`` above)."""
-        return self._gen[slot]
+        with self._lock:
+            return self._gen[slot]
 
     def release(self, slot: int) -> None:
-        if slot not in self._in_use:
-            raise ValueError(f"slot {slot} is not in use")
-        self._in_use.remove(slot)
-        heapq.heappush(self._free, slot)
+        with self._lock:
+            note_access("pool.freelist", write=True)
+            if slot not in self._in_use:
+                raise ValueError(f"slot {slot} is not in use")
+            self._in_use.remove(slot)
+            heapq.heappush(self._free, slot)
 
     def alloc_region(self, n_slots: int):
         """A second bounded cache region with the SAME per-slot layout

@@ -118,6 +118,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 
 import jax
@@ -149,6 +150,7 @@ from deeplearning4j_tpu.serving.faults import (
     PermanentFault,
     TransientFault,
 )
+from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache, Segment
 from deeplearning4j_tpu.serving.probe_cache import ProbeCache, probe_key
@@ -430,7 +432,14 @@ class ServingEngine:
 
         self._slots: list[_SlotState | None] = [None] * n_slots
         self._inflight: _Inflight | None = None
-        self._results: dict[str, np.ndarray] = {}
+        # terminal streams are written by the engine thread and read by
+        # HTTP handler threads (GET /v1/result pops them), so every
+        # access goes through the lock
+        self._results_lock = wrap_lock(threading.Lock(), "engine.results")
+        self._results: dict[str, np.ndarray] = {}  # guarded-by: _results_lock
+        # attached opt-in SyncSanitizer (None in production: the hot
+        # path pays one attribute-is-None check per phase)
+        self._san = None
         self._key = jax.random.key(rng_seed)
         # per-slot sampling keys, split from the master key at
         # admission (deterministic by admission order). The step
@@ -984,13 +993,16 @@ class ServingEngine:
         """Terminal streams by request id: prompt + generated tokens
         (partial for CANCELLED/EXPIRED/FAILED-while-running). Bounded
         to ``results_cap`` entries, oldest evicted; ``pop_result``
-        consumes an entry."""
-        return self._results
+        consumes an entry. Returns a snapshot — the live dict is shared
+        with the engine thread."""
+        with self._results_lock:
+            return dict(self._results)
 
     def pop_result(self, req_id: str, default=None):
         """Remove and return a terminal stream (front-end consumption:
         read-once keeps the results dict from growing with traffic)."""
-        return self._results.pop(req_id, default)
+        with self._results_lock:
+            return self._results.pop(req_id, default)
 
     @property
     def idle(self) -> bool:
@@ -1030,11 +1042,12 @@ class ServingEngine:
     # -- retirement --------------------------------------------------------
 
     def _store_result(self, req: Request, tokens: list[int]) -> None:
-        self._results[req.id] = np.concatenate(
-            [req.prompt, np.asarray(tokens, np.int32)]
-        )
-        while len(self._results) > self.results_cap:
-            self._results.pop(next(iter(self._results)))
+        stream = np.concatenate([req.prompt, np.asarray(tokens, np.int32)])
+        with self._results_lock:
+            note_access("engine.results", write=True)
+            self._results[req.id] = stream
+            while len(self._results) > self.results_cap:
+                self._results.pop(next(iter(self._results)))
 
     def _retire(self, slot: int, status: RequestStatus, now: float,
                 error: str | None = None, *,
@@ -1098,6 +1111,7 @@ class ServingEngine:
                 return slot
         return None
 
+    # lint: hot-path
     def _sweep_lifecycle(self, now: float) -> None:
         """Retire cancelled / deadline-expired occupied slots (this is
         what bounds slot occupation to one horizon past cancel/expiry).
@@ -1427,8 +1441,8 @@ class ServingEngine:
             p = params if tp_mesh is None else place_serving_tp_params(
                 tp_mesh, params, cfg
             )
-            p = jax.jit(cast_params)(p)
-            caches, logits = jax.jit(do_prefill)(
+            p = jax.jit(cast_params)(p)  # lint: retrace-ok one-shot parity probe
+            caches, logits = jax.jit(do_prefill)(  # lint: retrace-ok one-shot probe
                 p, init_caches(1, total), prompt
             )
             out = [np.asarray(logits)]
@@ -1640,13 +1654,14 @@ class ServingEngine:
         ))
         self.metrics.record_batched_admissions(len(group))
 
+    # lint: hot-path
     def _seat_plan(self, pl: _AdmitPlan, now: float) -> None:
         """Host bookkeeping that makes an executed plan a live slot:
         sampling key split (in admission order — the order replay
         reproduces), slot state, metrics, spans."""
         req, slot = pl.req, pl.slot
         self._key, sub = jax.random.split(self._key)
-        kd = np.asarray(jax.random.key_data(sub))
+        kd = np.asarray(jax.random.key_data(sub))  # lint: sync-ok per-admission key snapshot (tiny, off the decode critical section)
         self._slot_keys[slot] = kd
         st = _SlotState(req, self.pool.generation(slot), kd)
         if pl.seg is not None:
@@ -1704,6 +1719,7 @@ class ServingEngine:
                 length=seg.length,
             )
 
+    # lint: hot-path
     def _admit(self, now: float) -> None:
         """Admission at a horizon boundary: pop every admissible
         request (one per free slot), classify each against the prefix
@@ -1753,6 +1769,7 @@ class ServingEngine:
         finally:
             self._admitting -= 1
 
+    # lint: hot-path
     def _execute_plans(self, plans: list[_AdmitPlan],
                        now: float) -> None:
         # fault boundary first, in admission order, so scripted chaos
@@ -1832,6 +1849,7 @@ class ServingEngine:
 
     # -- supervised dispatch + pipelined readback --------------------------
 
+    # lint: hot-path
     def _dispatch(self) -> _Inflight | None:
         """Dispatch one fused K-substep horizon for every occupied slot
         under transient-retry supervision; returns the in-flight record
@@ -1852,19 +1870,21 @@ class ServingEngine:
         step_fn = self._step_fn_for(k)
         attempt, backoff = 0, self.retry_backoff_s
         t_call = time.perf_counter()
+        # .copy(): jnp.asarray can zero-copy alias the mutable host key
+        # buffer on CPU, and dispatch is async — a later admission
+        # writing a slot key must not race the in-flight step. The
+        # snapshot is what gets dispatched, and (under the sanitizer)
+        # what gets integrity-tracked until the readback.
+        keys_host = self._slot_keys.copy()
         while True:
             try:
                 if self.faults is not None:
                     self.faults.check("step")
-                # .copy(): jnp.asarray can zero-copy alias the mutable
-                # host key buffer on CPU, and dispatch is async — a
-                # concurrent admission writing a slot key must not race
-                # the in-flight step
                 (self.pool.caches, self._logits, self._dpos,
                  self._dactive, self._dbudget, toks) = step_fn(
                     self.params, self.pool.caches, self._logits,
                     self._dpos, self._dactive, self._dbudget,
-                    self._deos, jnp.asarray(self._slot_keys.copy()),
+                    self._deos, jnp.asarray(keys_host),
                 )
                 break
             except TransientFault as e:
@@ -1902,6 +1922,8 @@ class ServingEngine:
                     return None
         now = time.perf_counter()
         self.last_dispatch_t = now
+        if self._san is not None:
+            self._san.track("dispatch.slot_keys", keys_host)
         snaps = [(s, st) for s, st in enumerate(self._slots)
                  if st is not None]
         self.metrics.record_step(
@@ -1913,6 +1935,7 @@ class ServingEngine:
         )
         return _Inflight(toks, snaps, now)
 
+    # lint: hot-path
     def _process(self, horizon: _Inflight) -> None:
         """Sync a horizon's (slots, K) token block and do the host-side
         bookkeeping: append tokens (replaying the same EOS/budget
@@ -1920,7 +1943,11 @@ class ServingEngine:
         tokens, retire finished slots. Blocks whose slot was retired or
         re-acquired since dispatch are discarded."""
         t_sync = time.perf_counter()
-        toks_host = np.asarray(horizon.toks)  # THE host sync, 1/horizon
+        toks_host = np.asarray(horizon.toks)  # lint: sync-ok THE designated readback, 1/horizon
+        if self._san is not None:
+            # the program that read the dispatch-tracked buffers has
+            # completed: verify nothing mutated them while in flight
+            self._san.check("dispatch.slot_keys")
         now = time.perf_counter()
         self.metrics.record_readback(
             sync_wait_s=now - t_sync,
@@ -1966,6 +1993,20 @@ class ServingEngine:
             if finished:
                 self._finish(slot, now)
 
+    def attach_sanitizer(self, san) -> None:
+        """Attach an opt-in :class:`SyncSanitizer`: the engine stamps
+        its phase (sweep/admit/dispatch/process) onto the sanitizer's
+        thread-local so blocking syncs are attributed and budgeted, and
+        registers each dispatch's host key snapshot for in-flight
+        mutation checks. Detach with ``attach_sanitizer(None)``."""
+        self._san = san
+
+    def _set_phase(self, phase: str | None) -> None:
+        san = self._san
+        if san is not None:
+            san.set_phase(phase)
+
+    # lint: hot-path
     def step(self) -> bool:
         """One horizon boundary: sweep lifecycle, admit waiting
         requests, dispatch the next K-substep horizon, then sync and
@@ -1978,14 +2019,19 @@ class ServingEngine:
             prof.step_start()
         now = time.perf_counter()
         try:
+            self._set_phase("sweep")
             self._sweep_lifecycle(now)
+            self._set_phase("admit")
             self._admit(now)
+            self._set_phase("dispatch")
             prev, self._inflight = self._inflight, self._dispatch()
             if self._inflight is not None:
                 self._steps += 1
+            self._set_phase("process")
             if prev is not None:
                 self._process(prev)
         finally:
+            self._set_phase(None)
             if prof is not None:
                 prof.step_end()
         progressed = prev is not None or self._inflight is not None
@@ -2224,7 +2270,7 @@ class ServingEngine:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
-        return self._results
+        return self.results
 
 
 def run_request_trace(

@@ -48,6 +48,7 @@ import time
 from http.server import ThreadingHTTPServer
 from urllib.parse import urlparse
 
+from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
 from deeplearning4j_tpu.obs.logs import log_event
 from deeplearning4j_tpu.obs.registry import MetricsRegistry
 from deeplearning4j_tpu.utils.httpjson import (
@@ -126,12 +127,15 @@ class _Replica:
         self.host = host
         self.port = int(port)
         # optimistic until the first poll: a router started moments
-        # before its replicas shouldn't 503 the first request wave
-        self.healthy = True
-        self.in_flight = 0
+        # before its replicas shouldn't 503 the first request wave.
+        # healthy/in_flight/retried_away are flipped by HTTP handler
+        # threads AND the health poller, so they only move under the
+        # router's _route_lock
+        self.healthy = True  # guarded-by: _route_lock
+        self.in_flight = 0  # guarded-by: _route_lock
         self.routed = 0
         self.affinity_routed = 0
-        self.retried_away = 0
+        self.retried_away = 0  # guarded-by: _route_lock
         self.shadow = PrefixShadow()
         self.last_health: dict | None = None
         self.lock = threading.Lock()
@@ -140,7 +144,7 @@ class _Replica:
     def name(self) -> str:
         return f"{self.host}:{self.port}"
 
-    def state(self) -> dict:
+    def state(self) -> dict:  # lint: holds _route_lock
         return {
             "healthy": self.healthy,
             "in_flight": self.in_flight,
@@ -187,7 +191,9 @@ class ReplicaRouter:
         self.health_interval_s = float(health_interval_s)
         self.request_timeout_s = float(request_timeout_s)
         self._stop = threading.Event()
-        self._route_lock = threading.Lock()
+        self._route_lock = wrap_lock(
+            threading.Lock(), "router._route_lock"
+        )
         self._rr = 0  # round-robin tie-break cursor
 
         reg = self.registry = MetricsRegistry()
@@ -365,7 +371,8 @@ class ReplicaRouter:
                 return status, payload, replica.name
             except _ReplicaDown as e:
                 self._mark_unhealthy(replica, str(e))
-                replica.retried_away += 1
+                with self._route_lock:
+                    replica.retried_away += 1
                 self._m_retries.inc()
                 exclude.add(replica.name)
                 log_event(_log, "router_retry", replica=replica.name,
@@ -381,8 +388,12 @@ class ReplicaRouter:
     # ------------------------------------------------------------- #
 
     def _mark_unhealthy(self, replica: _Replica, why: str) -> None:
-        if replica.healthy:
-            replica.healthy = False
+        with self._route_lock:
+            note_access(f"router.{replica.name}.healthy", write=True)
+            flipped = replica.healthy
+            if flipped:
+                replica.healthy = False
+        if flipped:
             self._m_healthy.set(0.0, replica=replica.name)
             log_event(_log, "router_replica_down",
                       replica=replica.name, error=why)
@@ -407,11 +418,16 @@ class ReplicaRouter:
             ok = False
         finally:
             conn.close()
-        if ok and not replica.healthy:
-            replica.healthy = True
-            self._m_healthy.set(1.0, replica=replica.name)
-            log_event(_log, "router_replica_up", replica=replica.name)
-        elif not ok:
+        if ok:
+            with self._route_lock:
+                note_access(f"router.{replica.name}.healthy", write=True)
+                flipped = not replica.healthy
+                if flipped:
+                    replica.healthy = True
+            if flipped:
+                self._m_healthy.set(1.0, replica=replica.name)
+                log_event(_log, "router_replica_up", replica=replica.name)
+        else:
             self._mark_unhealthy(replica, "healthz poll failed")
 
     def poll_health(self) -> None:
@@ -426,15 +442,17 @@ class ReplicaRouter:
             self._stop.wait(self.health_interval_s)
 
     def health_payload(self) -> dict:
-        healthy = [r.name for r in self.replicas if r.healthy]
-        return {
-            "ok": bool(healthy),
-            "healthy": healthy,
-            "replicas": {r.name: r.healthy for r in self.replicas},
-        }
+        with self._route_lock:
+            healthy = [r.name for r in self.replicas if r.healthy]
+            return {
+                "ok": bool(healthy),
+                "healthy": healthy,
+                "replicas": {r.name: r.healthy for r in self.replicas},
+            }
 
     def replica_states(self) -> dict:
-        return {r.name: r.state() for r in self.replicas}
+        with self._route_lock:
+            return {r.name: r.state() for r in self.replicas}
 
     # ------------------------------------------------------------- #
     # lifecycle                                                      #

@@ -29,6 +29,8 @@ from collections import deque
 
 import numpy as np
 
+from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
+
 
 class RequestStatus(str, enum.Enum):
     """Request lifecycle. Terminal states set ``done`` and free the KV
@@ -136,11 +138,19 @@ class RequestScheduler:
         # batch and the prefix cache gets back-to-back hits. Promotion
         # stays within one priority class — strict priority still wins.
         self.prefix_affinity_tokens = prefix_affinity_tokens
-        self._queues = [deque() for _ in range(n_priorities)]
-        self._lock = threading.Lock()
+        self.n_priorities = n_priorities
+        self._lock = wrap_lock(threading.Lock(), "scheduler._lock")
+        # submit() runs on HTTP handler threads while pop()/requeue()
+        # run on the engine thread, so the queues only move under the
+        # lock
+        self._queues = [deque() for _ in range(n_priorities)]  # guarded-by: _lock
+
+    def _depth_unlocked(self) -> int:  # lint: holds _lock
+        return sum(len(q) for q in self._queues)
 
     def __len__(self) -> int:
-        return sum(len(q) for q in self._queues)
+        with self._lock:
+            return self._depth_unlocked()
 
     @property
     def depth(self) -> int:
@@ -155,12 +165,13 @@ class RequestScheduler:
                 f"request {req.id}: prompt+max_new ({total}) exceeds the "
                 f"per-slot token budget ({self.max_total_tokens})"
             )
-        if not 0 <= req.priority < len(self._queues):
+        if not 0 <= req.priority < self.n_priorities:
             raise AdmissionError(
-                f"priority {req.priority} outside [0, {len(self._queues)})"
+                f"priority {req.priority} outside [0, {self.n_priorities})"
             )
         with self._lock:
-            if len(self) >= self.max_queue_depth:
+            note_access("scheduler.queues", write=True)
+            if self._depth_unlocked() >= self.max_queue_depth:
                 raise Backpressure(
                     f"queue at max depth ({self.max_queue_depth})"
                 )
@@ -175,6 +186,7 @@ class RequestScheduler:
         dropped between pop and admission). Bypasses depth/budget
         checks — the request was already admitted once."""
         with self._lock:
+            note_access("scheduler.queues", write=True)
             req.status = RequestStatus.QUEUED
             self._queues[req.priority].appendleft(req)
 
@@ -217,6 +229,7 @@ class RequestScheduler:
         only ever moves EARLIER)."""
         k = self.prefix_affinity_tokens
         with self._lock:
+            note_access("scheduler.queues", write=True)
             for q in self._queues:
                 if not q:
                     continue

@@ -200,11 +200,13 @@ class ServingServer:
                 send_json(self, 200, out)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # named threads: sanitizer reports (and py-spy dumps)
+        # attribute races/locks to "engine-loop" vs "http-serve"
         self._engine_thread = threading.Thread(
-            target=self._engine_loop, daemon=True
+            target=self._engine_loop, daemon=True, name="engine-loop"
         )
         self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+            target=self._httpd.serve_forever, daemon=True, name="http-serve"
         )
 
         # optional scrape sidecar: /metrics (+ health) on its own port,
@@ -222,7 +224,8 @@ class ServingServer:
                 (host, metrics_port), MetricsHandler
             )
             self._metrics_thread = threading.Thread(
-                target=self._metrics_httpd.serve_forever, daemon=True
+                target=self._metrics_httpd.serve_forever, daemon=True,
+                name="metrics-serve",
             )
 
     @property

@@ -1,0 +1,710 @@
+"""graftlint + runtime-sanitizer suite.
+
+Two halves, mirroring ``deeplearning4j_tpu/analysis``:
+
+- STATIC: each rule is fed synthetic sources seeded with the exact bug
+  class it exists for (host sync in a hot path, the PR-2 aliasing race,
+  PRNG reuse, guarded-by violations, trace-cache defeats) and must flag
+  the violation AND stay quiet on the blessed idiom next to it. Plus
+  baseline mechanics (stable keys, stale detection, --strict) and the
+  load-bearing meta-test: the linter runs clean over this repo.
+- RUNTIME: each sanitizer is fed a seeded violation (lock-order
+  inversion, unlocked cross-thread write, blocking sync inside the
+  dispatch critical section, in-flight buffer mutation, out-of-family
+  compiled program) and must report it; the disabled path must be
+  bit-identical to production (raw locks, pristine numpy functions) —
+  the same zero-overhead bar ``test_obs.py`` holds the tracer to.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis.baseline import Baseline
+from deeplearning4j_tpu.analysis.core import ModuleInfo
+from deeplearning4j_tpu.analysis.lint import (
+    default_root,
+    lint_paths,
+    main as lint_main,
+)
+from deeplearning4j_tpu.analysis.rules import run_rules
+from deeplearning4j_tpu.analysis.sanitizers import (
+    CompileCountGuard,
+    LockSanitizer,
+    SanitizerViolation,
+    SyncSanitizer,
+    note_access,
+    wrap_lock,
+)
+
+
+def _findings(src, rules=None):
+    return run_rules(ModuleInfo("synthetic.py", src, "synthetic.py"),
+                     rules=rules)
+
+
+def _rules_fired(src, rules=None):
+    return [f.rule for f in _findings(src, rules)]
+
+
+# -- rule: host-sync ------------------------------------------------------
+
+
+def test_host_sync_flags_hot_path_only():
+    src = '''
+import numpy as np
+
+# lint: hot-path
+def dispatch(x):
+    return np.asarray(x)
+
+def cold(x):
+    return np.asarray(x)
+'''
+    fs = _findings(src, ["host-sync"])
+    assert [f.qualname for f in fs] == ["dispatch"]
+
+
+def test_host_sync_flags_item_float_bool():
+    src = '''
+# lint: hot-path
+def f(x, y):
+    a = x.item()
+    b = float(y)
+    c = bool(x)
+    return a, b, c
+'''
+    assert _rules_fired(src, ["host-sync"]) == ["host-sync"] * 3
+
+
+def test_host_sync_sync_ok_suppresses():
+    src = '''
+import numpy as np
+
+# lint: hot-path
+def process(toks):
+    host = np.asarray(toks)  # lint: sync-ok the designated readback
+    return host
+'''
+    assert _findings(src, ["host-sync"]) == []
+
+
+# -- rule: zero-copy-alias ------------------------------------------------
+
+
+def test_alias_flags_mutation_after_dispatch():
+    src = '''
+import numpy as np
+import jax.numpy as jnp
+
+def f(fn, seq):
+    buf = np.zeros((8,), np.int32)
+    fn(jnp.asarray(buf))
+    buf[0] = 1
+'''
+    assert _rules_fired(src, ["zero-copy-alias"]) == ["zero-copy-alias"]
+
+
+def test_alias_flags_buffer_hoisted_out_of_loop():
+    # the engine's `pos` replay race: one buffer, dispatched and
+    # mutated every iteration — iteration N's write races iteration
+    # N-1's in-flight program
+    src = '''
+import numpy as np
+import jax.numpy as jnp
+
+def g(fn, n):
+    pos = np.zeros((4,), np.int32)
+    for j in range(n):
+        fn(jnp.asarray(pos))
+        pos[0] += 1
+'''
+    assert _rules_fired(src, ["zero-copy-alias"]) == ["zero-copy-alias"]
+
+
+def test_alias_fresh_buffer_per_iteration_is_clean():
+    # rebinding starts a new generation: every iteration dispatches a
+    # buffer nothing will ever write to again (the engine's `pad`
+    # prefill idiom)
+    src = '''
+import numpy as np
+import jax.numpy as jnp
+
+def g(fn, chunks):
+    for seq in chunks:
+        pad = np.zeros((1, 8), np.int32)
+        pad[0, :len(seq)] = seq
+        fn(jnp.asarray(pad))
+'''
+    assert _findings(src, ["zero-copy-alias"]) == []
+
+
+def test_alias_defensive_copy_is_clean():
+    src = '''
+import numpy as np
+import jax.numpy as jnp
+
+def g(fn, n):
+    pos = np.zeros((4,), np.int32)
+    for j in range(n):
+        fn(jnp.asarray(pos.copy()))
+        pos[0] += 1
+'''
+    assert _findings(src, ["zero-copy-alias"]) == []
+
+
+def test_alias_class_attribute_variant():
+    src = '''
+import numpy as np
+import jax.numpy as jnp
+
+class Engine:
+    def seat(self, slot, kd):
+        self.keys[slot] = kd
+
+    def dispatch(self, fn):
+        return fn(jnp.asarray(self.keys))
+
+    def dispatch_safe(self, fn):
+        return fn(jnp.asarray(self.keys.copy()))
+'''
+    fs = _findings(src, ["zero-copy-alias"])
+    assert [f.qualname for f in fs] == ["Engine.dispatch"]
+
+
+def test_alias_ok_suppresses():
+    src = '''
+import jax.numpy as jnp
+
+def f(fn, buf):
+    fn(jnp.asarray(buf))  # lint: alias-ok caller guarantees no writes
+    buf[0] = 1
+'''
+    assert _findings(src, ["zero-copy-alias"]) == []
+
+
+# -- rule: prng-reuse -----------------------------------------------------
+
+
+def test_prng_flags_double_consume():
+    src = '''
+import jax
+
+def f(model, x):
+    k = jax.random.split(jax.random.key(0), 2)[0]
+    a = model(x, k)
+    b = model(x, k)
+    return a, b
+'''
+    fs = _findings(src, ["prng-reuse"])
+    assert [f.rule for f in fs] == ["prng-reuse"]
+
+
+def test_prng_split_between_sinks_is_clean():
+    src = '''
+import jax
+
+def f(model, x, key):
+    key, k1 = jax.random.split(key)
+    a = model(x, k1)
+    key, k2 = jax.random.split(key)
+    b = model(x, k2)
+    return a, b
+'''
+    assert _findings(src, ["prng-reuse"]) == []
+
+
+def test_prng_exclusive_branches_are_clean():
+    src = '''
+import jax
+
+def f(model, x, flag):
+    k = jax.random.split(jax.random.key(0), 2)[1]
+    if flag:
+        return model(x, k)
+    else:
+        return model(x * 2, k)
+'''
+    assert _findings(src, ["prng-reuse"]) == []
+
+
+def test_prng_outer_key_consumed_in_loop_flags():
+    src = '''
+import jax
+
+def f(model, xs):
+    k = jax.random.split(jax.random.key(0), 2)[0]
+    out = []
+    for x in xs:
+        out.append(model(x, k))
+    return out
+'''
+    assert _rules_fired(src, ["prng-reuse"]) == ["prng-reuse"]
+
+
+# -- rule: lock-discipline ------------------------------------------------
+
+
+def test_lock_discipline_guarded_by():
+    src = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = []  # guarded-by: _lock
+
+    def bad(self):
+        return len(self._free)
+
+    def good(self):
+        with self._lock:
+            return len(self._free)
+
+    def helper(self):  # lint: holds _lock
+        return self._free.pop()
+'''
+    fs = _findings(src, ["lock-discipline"])
+    assert [f.qualname for f in fs] == ["Pool.bad"]
+
+
+def test_lock_ok_suppresses():
+    src = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = []  # guarded-by: _lock
+
+    def snapshot(self):
+        return list(self._free)  # lint: lock-ok read-only debug dump
+'''
+    assert _findings(src, ["lock-discipline"]) == []
+
+
+# -- rule: retrace-hazard -------------------------------------------------
+
+
+def test_retrace_flags_immediate_invocation_and_loop_jit():
+    src = '''
+import jax
+
+def serve(fns, xs):
+    out = [jax.jit(fns[0])(xs[0])]
+    for f in fns:
+        g = jax.jit(f)
+        out.append(g(xs[0]))
+    return out
+
+class E:
+    def __init__(self, f):
+        self._fn = jax.jit(f)
+'''
+    fs = _findings(src, ["retrace-hazard"])
+    assert len(fs) == 2  # immediate call + jit-in-loop; __init__ exempt
+    assert all(f.qualname == "serve" for f in fs)
+
+
+def test_retrace_ok_suppresses():
+    src = '''
+import jax
+
+def probe(f, x):
+    return jax.jit(f)(x)  # lint: retrace-ok one-shot probe
+'''
+    assert _findings(src, ["retrace-hazard"]) == []
+
+
+# -- finding keys + baseline ----------------------------------------------
+
+
+def test_finding_key_is_line_number_independent():
+    src = '''
+import numpy as np
+
+# lint: hot-path
+def f(x):
+    return np.asarray(x)
+'''
+    (f1,) = _findings(src, ["host-sync"])
+    (f2,) = _findings("\n\n\n" + src, ["host-sync"])
+    assert f1.line != f2.line
+    assert f1.key == f2.key
+
+
+def test_baseline_roundtrip_and_stale(tmp_path):
+    src = '''
+import numpy as np
+
+# lint: hot-path
+def f(x):
+    return np.asarray(x)
+'''
+    (f1,) = _findings(src, ["host-sync"])
+    path = tmp_path / ".graftlint.json"
+    bl = Baseline(str(path))
+    bl.write([f1])
+    data = json.loads(path.read_text())
+    assert data["version"] == 1
+    assert data["accepted"][0]["key"] == f1.key
+    assert data["accepted"][0]["reason"].startswith("TODO")
+
+    bl2 = Baseline(str(path))
+    new, suppressed, stale = bl2.split([f1])
+    assert (new, len(suppressed), stale) == ([], 1, [])
+    # the site disappears -> its entry goes stale
+    new, suppressed, stale = bl2.split([])
+    assert stale == [f1.key]
+
+
+def test_lint_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "# lint: hot-path\n"
+        "def f(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    bl = tmp_path / "bl.json"
+    assert lint_main([str(bad), "--no-baseline"]) == 1
+    assert lint_main([str(bad), "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    # baselined -> clean; --strict still fails on the TODO reason
+    assert lint_main([str(bad), "--baseline", str(bl)]) == 0
+    assert lint_main([str(bad), "--baseline", str(bl), "--strict"]) == 1
+    data = json.loads(bl.read_text())
+    data["accepted"][0]["reason"] = "probe path, compiled once"
+    bl.write_text(json.dumps(data))
+    assert lint_main([str(bad), "--baseline", str(bl), "--strict"]) == 0
+
+
+def test_repo_lints_clean():
+    """The load-bearing meta-test: the shipped package has no
+    unaccepted findings under all five rules (CI runs the same check
+    via ``python -m deeplearning4j_tpu lint --strict``)."""
+    findings, errors = lint_paths([default_root()])
+    assert errors == []
+    assert [f.render() for f in findings] == []
+
+
+# -- sanitizers: disabled path --------------------------------------------
+
+
+def test_disabled_sanitizers_cost_nothing():
+    """Mirror of the tracer's overhead guard: with no sanitizer
+    installed, wrap_lock is the identity, numpy's functions are the
+    pristine originals, and note_access is a no-op."""
+    lock = threading.Lock()
+    assert wrap_lock(lock, "x") is lock
+    orig_asarray, orig_array = np.asarray, np.array
+    note_access("anything", write=True)  # must not record or raise
+    assert np.asarray is orig_asarray
+    assert np.array is orig_array
+
+    san = SyncSanitizer().install()
+    try:
+        assert np.asarray is not orig_asarray
+    finally:
+        san.uninstall()
+    # uninstall restores the exact originals
+    assert np.asarray is orig_asarray
+    assert np.array is orig_array
+    assert wrap_lock(lock, "x") is lock
+
+
+# -- sanitizers: seeded violations ----------------------------------------
+
+
+def test_lock_sanitizer_reports_order_inversion():
+    with LockSanitizer() as san:
+        a = wrap_lock(threading.Lock(), "a")
+        b = wrap_lock(threading.Lock(), "b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # closes the a->b->a cycle
+                pass
+    assert any("lock-order inversion" in v for v in san.violations)
+    with pytest.raises(SanitizerViolation):
+        san.assert_clean()
+
+
+def test_lock_sanitizer_consistent_order_is_clean():
+    with LockSanitizer() as san:
+        a = wrap_lock(threading.Lock(), "a")
+        b = wrap_lock(threading.Lock(), "b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    san.assert_clean()
+
+
+def test_lock_sanitizer_reports_unlocked_cross_thread_write():
+    with LockSanitizer() as san:
+        def writer():
+            note_access("shared.table", write=True)
+
+        t = threading.Thread(target=writer, name="other-writer")
+        t.start()
+        t.join()
+        note_access("shared.table", write=True)
+    assert any("shared.table" in v for v in san.violations)
+
+
+def test_lock_sanitizer_single_writer_is_clean():
+    # single-writer/multi-reader under the GIL is the codebase's
+    # blessed pattern (server._last_beat etc.) — not a violation
+    with LockSanitizer() as san:
+        for _ in range(5):
+            note_access("swmr.value", write=True)
+    san.assert_clean()
+
+
+def test_sync_sanitizer_budget_and_phases():
+    import jax
+
+    x = jax.numpy.arange(4)
+    san = SyncSanitizer(budgets={"dispatch": 0}).install()
+    try:
+        san.set_phase("process")
+        np.asarray(x)
+        np.asarray(np.arange(4))  # plain numpy: not a device sync
+        san.set_phase("dispatch")
+        np.asarray(x)  # over budget
+    finally:
+        san.uninstall()
+    assert san.sync_count("process") == 1
+    assert san.sync_count("dispatch") == 1
+    assert any("dispatch" in v for v in san.violations)
+    with pytest.raises(SanitizerViolation):
+        san.assert_budgets()
+
+
+def test_sync_sanitizer_alias_tripwire():
+    san = SyncSanitizer()
+    buf = np.arange(8, dtype=np.int32)
+    san.track("dispatch.keys", buf)
+    san.check("dispatch.keys")
+    assert san.violations == []
+    san.track("dispatch.keys", buf)
+    buf[3] = 99  # mutated while "in flight"
+    san.check("dispatch.keys")
+    assert any("in flight" in v for v in san.violations)
+
+
+# -- sanitizers: engine integration ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_serving():
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+        max_len=32,
+    )
+    return cfg, init_transformer(jax.random.key(0), cfg)
+
+
+def _engine(cfg, params, **kw):
+    from deeplearning4j_tpu.serving import ServingEngine
+
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("decode_horizon", 2)
+    return ServingEngine(cfg, params, **kw)
+
+
+def _reqs(n, seed=0):
+    from deeplearning4j_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return [
+        Request(
+            id=f"r{i}",
+            prompt=rng.integers(1, 60, (int(rng.integers(3, 8)),))
+            .astype(np.int32),
+            max_new=int(rng.integers(3, 8)),
+        )
+        for i in range(n)
+    ]
+
+
+def test_engine_clean_under_all_sanitizers(tiny_serving):
+    """A full serve run with every sanitizer armed: zero blocking
+    syncs inside the dispatch critical section, exactly one readback
+    per processed horizon, untouched dispatch buffers, compiled
+    programs inside their contract families, no lock findings."""
+    cfg, params = tiny_serving
+    lock_san = LockSanitizer().install()
+    sync_san = SyncSanitizer().install()
+    try:
+        eng = _engine(cfg, params)
+        eng.attach_sanitizer(sync_san)
+        for r in _reqs(4):
+            eng.scheduler.submit(r)
+        results = eng.run()
+    finally:
+        sync_san.uninstall()
+        lock_san.uninstall()
+    assert len(results) == 4
+    lock_san.assert_clean()
+    sync_san.assert_clean()
+    sync_san.assert_budgets()
+    assert sync_san.sync_count("dispatch") == 0
+    assert sync_san.sync_count("process") >= 1
+    CompileCountGuard(eng).assert_ok()
+    assert lock_san.n_wrapped > 0  # the stack's locks went through wrap_lock
+
+
+def test_engine_seeded_alias_mutation_is_caught(tiny_serving):
+    """Simulate the PR-2 race the defensive .copy() prevents: mutate
+    the host buffer the in-flight step program is (conceptually)
+    reading; the readback integrity check must fire."""
+    cfg, params = tiny_serving
+    sync_san = SyncSanitizer().install()
+    try:
+        eng = _engine(cfg, params)
+        eng.attach_sanitizer(sync_san)
+        eng.scheduler.submit(_reqs(1, seed=3)[0])
+        eng.step()  # admit + dispatch: tracks the key snapshot
+        tracked = sync_san._tracked.get("dispatch.slot_keys")
+        assert tracked  # one outstanding dispatch
+        buf, _snap = tracked[0]
+        buf[...] += 1  # concurrent writer corrupts the in-flight buffer
+        eng.step()  # processes the previous horizon -> check() fires
+    finally:
+        sync_san.uninstall()
+    assert any("in flight" in v for v in sync_san.violations)
+
+
+def test_compile_count_guard_flags_out_of_family_program(tiny_serving):
+    cfg, params = tiny_serving
+    eng = _engine(cfg, params)
+    eng.scheduler.submit(_reqs(1)[0])
+    eng.run()
+    CompileCountGuard(eng).assert_ok()
+    eng._step_fns[7] = object()  # a request-shaped key: retrace bug
+    with pytest.raises(SanitizerViolation):
+        CompileCountGuard(eng).assert_ok()
+    del eng._step_fns[7]
+    eng._prefill_fns[13] = object()  # off the pow2 bucket grid
+    with pytest.raises(SanitizerViolation):
+        CompileCountGuard(eng).assert_ok()
+
+
+# -- regression: the real findings this suite was built from ---------------
+
+
+def test_scheduler_len_is_locked_and_consistent():
+    """__len__ now snapshots under the scheduler lock (it used to read
+    the deques bare while HTTP threads appended); submit still works
+    while holding the lock internally (re-entrancy regression)."""
+    from deeplearning4j_tpu.serving import RequestScheduler
+
+    s = RequestScheduler(max_queue_depth=64)
+    for r in _reqs(8, seed=1):
+        s.submit(r)
+    assert len(s) == 8
+    # concurrent submit/len/pop must neither deadlock nor miscount
+    errs = []
+
+    def hammer(seed):
+        try:
+            for r in _reqs(16, seed=seed):
+                s.submit(r)
+                len(s)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer, args=(i,)) for i in (2, 3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == []
+    assert len(s) == 8 + 32
+
+
+def test_registry_scrape_survives_concurrent_labelset_inserts():
+    """Regression for the scrape race: render() used to iterate the
+    label-set dicts unlocked while first-time label sets inserted from
+    other threads ("dict changed size during iteration")."""
+    from deeplearning4j_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", labelnames=("k",))
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0),
+                      labelnames=("k",))
+    stop = threading.Event()
+    errs = []
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                reg.render()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    t = threading.Thread(target=scraper, name="metrics-serve")
+    t.start()
+    try:
+        for i in range(300):
+            c.inc(k=str(i))
+            h.observe(0.05, k=str(i))
+    finally:
+        stop.set()
+        t.join()
+    assert errs == []
+    assert "hits_total" in reg.render()
+
+
+def test_router_health_flips_are_locked():
+    """Regression: _mark_unhealthy/_poll_one used to flip
+    replica.healthy without the route lock while _pick read it. The
+    flip is idempotent and replica_states snapshots consistently."""
+    from deeplearning4j_tpu.serving.router import ReplicaRouter
+
+    router = ReplicaRouter([("127.0.0.1", 1), ("127.0.0.1", 2)])
+    try:
+        r0 = router.replicas[0]
+        router._mark_unhealthy(r0, "seeded")
+        router._mark_unhealthy(r0, "seeded again")  # no double-flip
+        states = router.replica_states()
+        assert states[r0.name]["healthy"] is False
+        payload = router.health_payload()
+        assert payload["replicas"][r0.name] is False
+        assert payload["ok"] is True  # the other replica still routes
+    finally:
+        router._httpd.server_close()
+
+
+def test_router_locks_are_sanitizer_clean_under_mark_unhealthy():
+    """The router's health flip path under the LockSanitizer: takes
+    _route_lock then the metric instrument lock, same order as _pick —
+    no inversion, no unlocked write."""
+    from deeplearning4j_tpu.serving.router import ReplicaRouter
+
+    with LockSanitizer() as san:
+        router = ReplicaRouter([("127.0.0.1", 1)])
+        try:
+            threading.Thread(
+                target=router._mark_unhealthy,
+                args=(router.replicas[0], "from poller"),
+                name="health-poll",
+            ).start()
+            router.poll_health()
+            router.replica_states()
+        finally:
+            router._httpd.server_close()
+    san.assert_clean()

@@ -62,6 +62,20 @@ def _requests(n, seed=0):
     return out
 
 
+@pytest.fixture(autouse=True)
+def _lock_sanitized():
+    """The whole chaos suite runs under the LockSanitizer: every
+    engine/server/scheduler lock built inside a test is order- and
+    lockset-tracked across the fault-injection/recovery paths, and any
+    inversion or unlocked cross-thread write fails the test that
+    provoked it."""
+    from deeplearning4j_tpu.analysis.sanitizers import LockSanitizer
+
+    with LockSanitizer() as san:
+        yield san
+    san.assert_clean()
+
+
 def _clone(reqs):
     """Same prompts/budgets, fresh ids/state — for a faulted re-run."""
     return [Request(prompt=r.prompt.copy(), max_new=r.max_new) for r in reqs]
