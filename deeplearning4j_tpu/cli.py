@@ -451,10 +451,17 @@ def cmd_serve(args) -> int:
         RequestScheduler,
         ServingEngine,
         ServingServer,
+        TenantRegistry,
     )
 
     if args.log_json:
         configure_json_logging()
+
+    tenancy = None
+    if args.tenants:
+        tenancy = TenantRegistry.from_file(args.tenants)
+        print(f"tenancy: {len(tenancy)} tenants from {args.tenants} "
+              f"({', '.join(tenancy.tenant_ids())})")
 
     if args.demo:
         from deeplearning4j_tpu.models.transformer import init_transformer
@@ -472,6 +479,24 @@ def cmd_serve(args) -> int:
         if isinstance(restored, int):
             return restored
         cfg, params = restored
+
+    lora_bank = None
+    if args.lora_adapters > 0:
+        from deeplearning4j_tpu.models.transformer import init_lora_bank
+
+        lora_bank = init_lora_bank(
+            jax.random.PRNGKey(args.lora_seed), cfg,
+            n_adapters=args.lora_adapters, rank=args.lora_rank,
+        )
+        print(f"batched LoRA: {args.lora_adapters} adapters "
+              f"(rank {args.lora_rank}, index 0 = base model); "
+              f"requests pick one via 'adapter' or the tenant default")
+
+    embedders = None
+    if args.embed_models:
+        embedders = _demo_embedders(args.embed_models.split(","))
+        print(f"embeddings: POST /v1/embeddings over "
+              f"{', '.join(sorted(embedders))} (demo vocab)")
 
     faults = None
     if args.chaos_rate > 0:
@@ -515,7 +540,11 @@ def cmd_serve(args) -> int:
         scheduler=RequestScheduler(
             max_queue_depth=args.max_queue,
             prefix_affinity_tokens=args.prefix_affinity_tokens,
+            tenancy=tenancy,
         ),
+        tenancy=tenancy,
+        lora_bank=lora_bank,
+        embedders=embedders,
         rng_seed=args.seed,
         faults=faults,
         tracer=tracer,
@@ -527,6 +556,9 @@ def cmd_serve(args) -> int:
     )
     if sans is not None:
         engine.attach_sanitizer(sans[1])
+    if lora_bank is not None and engine.n_adapters == 0:
+        print("batched LoRA DISABLED (adapter-0 parity probe failed); "
+              "serving the base model", file=sys.stderr)
     if args.tp > 1:
         if engine.tp == args.tp:
             print(f"tensor parallel: decode sharded over {engine.tp} "
@@ -612,6 +644,46 @@ def _report_sanitizers(engine, lock_san, sync_san) -> int:
         return 1
     print("sanitizers: clean")
     return 0
+
+
+#: tiny deterministic corpus for --embed-models demo vocabularies
+_DEMO_SENTENCES = [
+    "the quick brown fox jumps over the lazy dog",
+    "a day in the life of a serving engine",
+    "music in the park makes the day go by",
+    "the fox and the dog share the park",
+    "continuous batching keeps the engine busy all day",
+]
+
+
+def _demo_embedders(names: list[str]) -> dict:
+    """Zoo embedding models over a tiny fixed corpus for the
+    /v1/embeddings demo: word2vec gets random-init vectors (vocab +
+    reset_weights, no training), glove a few fast epochs — enough to
+    prove the endpoint routes through the serving machinery; real
+    deployments would load trained tables."""
+    out = {}
+    for name in names:
+        name = name.strip().lower()
+        if not name:
+            continue
+        if name == "word2vec":
+            from deeplearning4j_tpu.models.word2vec import Word2Vec
+
+            m = Word2Vec(layer_size=16, seed=0)
+            m.build_vocab(_DEMO_SENTENCES)
+            m.reset_weights()
+        elif name == "glove":
+            from deeplearning4j_tpu.models.glove import Glove
+
+            m = Glove(layer_size=16, epochs=1, seed=0)
+            m.fit(_DEMO_SENTENCES)
+        else:
+            raise ValueError(
+                f"unknown embed model {name!r} (word2vec|glove)"
+            )
+        out[name] = m
+    return out
 
 
 def _write_port_file(path: str, server) -> None:
@@ -979,6 +1051,28 @@ def main(argv: list[str] | None = None) -> int:
                    "(config, backend, geometry), so replica fleets and "
                    "restarts skip cold-start probe dispatches. "
                    "'off' disables persistence")
+    v.add_argument("--tenants", default=None, metavar="PATH",
+                   help="JSON tenant registry enabling multi-tenant "
+                   "serving: API-key resolution (X-API-Key / Bearer), "
+                   "per-tenant priority + weighted-fair share, KV-slot "
+                   "caps, token-rate quotas (429), and a default LoRA "
+                   "adapter per tenant. See README 'Multi-tenant "
+                   "serving' for the schema")
+    v.add_argument("--lora-adapters", type=int, default=0, metavar="N",
+                   help="load a batched-LoRA bank of N adapters "
+                   "(random-init demo factors; index 0 is the zero "
+                   "adapter = bitwise base model) so one engine serves "
+                   "N fine-tunes in one decode batch; requests select "
+                   "one via 'adapter' or the tenant's default_adapter. "
+                   "0 = no bank")
+    v.add_argument("--lora-rank", type=int, default=4,
+                   help="low-rank dimension of the demo LoRA factors")
+    v.add_argument("--lora-seed", type=int, default=0,
+                   help="PRNG seed for the demo LoRA bank")
+    v.add_argument("--embed-models", default=None, metavar="M[,M]",
+                   help="comma-separated zoo embedding models "
+                   "(word2vec, glove) to serve at POST /v1/embeddings "
+                   "over a small demo vocabulary")
     # model flags for --demo / pre-config checkpoints
     v.add_argument("--seq-len", type=int, default=128)
     v.add_argument("--d-model", type=int, default=128)

@@ -363,7 +363,8 @@ def place_transformer_params(mesh: Mesh, params, cfg=None):
     return jax.tree.map(mesh_lib.place_global, params, shardings)
 
 
-def serving_tp_shardings(mesh: Mesh, cfg: TransformerConfig):
+def serving_tp_shardings(mesh: Mesh, cfg: TransformerConfig,
+                         lora: bool = False):
     """Exact-parity tensor-parallel SERVING layout over the mesh's model
     axis, as a shardings pytree mirroring ``init_transformer``.
 
@@ -404,7 +405,7 @@ def serving_tp_shardings(mesh: Mesh, cfg: TransformerConfig):
         }
     else:
         attn = {"wqkv": ns(None, None, None, m, None)}
-    return {
+    out = {
         "embed": rep,
         "pos": rep,
         "blocks": {
@@ -423,6 +424,21 @@ def serving_tp_shardings(mesh: Mesh, cfg: TransformerConfig):
         "lnf_bias": rep,
         "head": ns(None, m),  # vocab-sharded logits, gathered at the tail
     }
+    if lora:
+        # the LoRA attach points are both COLUMN projections, so the
+        # bank follows the column layout: A factors replicated (their
+        # r-dim contraction runs fully on every rank), B factors
+        # sharded on the output dim — b_q's packed n_heads*head_dim
+        # minor splits head-major, matching wq's head sharding; b_mlp
+        # splits d_ff, matching w1. Deltas land shard-local before the
+        # forced gathers, so batched LoRA under TP stays bitwise exact.
+        out["lora"] = {
+            "a_q": rep,
+            "b_q": ns(None, None, None, m),
+            "a_mlp": rep,
+            "b_mlp": ns(None, None, None, m),
+        }
+    return out
 
 
 def place_serving_tp_params(mesh: Mesh, params, cfg: TransformerConfig):
@@ -430,7 +446,7 @@ def place_serving_tp_params(mesh: Mesh, params, cfg: TransformerConfig):
     exact-TP layout of :func:`serving_tp_shardings`; int8 ``name_scale``
     leaves get shardings derived from their weight's spec, exactly as
     :func:`place_transformer_params` does for the training layout."""
-    shardings = serving_tp_shardings(mesh, cfg)
+    shardings = serving_tp_shardings(mesh, cfg, lora="lora" in params)
     blocks = params["blocks"]
     if any(
         name in blocks and blocks[name].dtype == jnp.int8
@@ -587,7 +603,7 @@ def _tp_replicate(x, tp_mesh):
     )
 
 
-def _mlp(p, h_in, tp_mesh=None):
+def _mlp(p, h_in, tp_mesh=None, delta1=None, sel=None):
     """Shared dense FFN (gelu) over (..., D) activations.
 
     Under the exact-TP serving layout (``tp_mesh`` set) ``w1``/``b1``
@@ -595,16 +611,90 @@ def _mlp(p, h_in, tp_mesh=None):
     before the ``w2`` matmul against a REPLICATED ``w2`` — the d_ff
     reduction then runs in the single-chip order, so the output is
     bitwise identical to the unsharded path (a row-parallel ``w2``
-    would psum partial sums in a different association)."""
-    h = jax.nn.gelu(
+    would psum partial sums in a different association).
+
+    ``delta1`` (optional) is a batched-LoRA pre-activation delta added
+    to the w1 projection before the gelu, gated per row by ``sel``
+    (bool, broadcastable to the hidden): rows with ``sel`` False keep
+    the exact base activations — adding an all-zero delta instead
+    would still flip -0.0 bits and break the adapter-0 parity bar."""
+    h = (
         jnp.einsum("...d,df->...f", h_in, _w(p, "w1", h_in.dtype))
         + p["b1"].astype(h_in.dtype)
     )
+    if delta1 is not None:
+        h = jnp.where(sel, h + delta1, h)
+    h = jax.nn.gelu(h)
     h = _tp_replicate(h, tp_mesh)
     return (
         jnp.einsum("...f,fd->...d", h, _w(p, "w2", h_in.dtype))
         + p["b2"].astype(h_in.dtype)
     )
+
+
+def init_lora_bank(
+    key, cfg: TransformerConfig, n_adapters: int, rank: int,
+    scale: float = 0.5,
+):
+    """Stacked low-rank adapter bank for batched-LoRA serving: N
+    adapters' (A, B) factors for the q projection and the MLP w1
+    projection of every layer, as FOUR stacked device arrays so one
+    fused decode step can gather each KV slot's adapter rows by index
+    (S-LoRA/Punica style) instead of swapping weights per request.
+
+    Layout (``nl`` layers, ``N`` adapters, rank ``r``)::
+
+        a_q   (nl, N, d_model, r)    b_q   (nl, N, r, n_heads*head_dim)
+        a_mlp (nl, N, d_model, r)    b_mlp (nl, N, r, d_ff)
+
+    The leading layer axis matches ``params["blocks"]`` so prefill's
+    ``lax.scan`` scans the bank alongside the blocks. Adapter index 0
+    is the ZERO adapter (both factors zeroed): slots carrying 0 take
+    the base-model path bitwise (the forward selects, not adds — see
+    ``_mlp``). Unlike training-style LoRA init (B=0), adapters 1..N-1
+    get random nonzero A *and* B so distinct adapters produce distinct
+    outputs out of the box — the serving tests and the bench need
+    observable divergence without a training loop.
+
+    Attach points are activation-level deltas (q after projection /
+    pre-RoPE, MLP pre-gelu), so GQA (wq) and MHA (wqkv) configs share
+    one code path; both are COLUMN projections under the exact-TP
+    layout, so the bank shards with ``serving_tp_shardings`` (A
+    replicated, B on its output dim) and stays bitwise exact.
+    """
+    if cfg.n_experts:
+        raise ValueError("batched LoRA does not support MoE configs")
+    if n_adapters < 2:
+        raise ValueError(
+            f"n_adapters must be >= 2 (index 0 is the zero adapter), "
+            f"got {n_adapters}"
+        )
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    nl, d, f = cfg.n_layers, cfg.d_model, cfg.d_ff
+    hk = cfg.n_heads * cfg.head_dim
+    ks = jax.random.split(key, 4)
+
+    def factor(k, shape):
+        a = scale * jax.random.normal(k, shape, jnp.float32)
+        return a.at[:, 0].set(0.0)  # adapter 0 = zero adapter
+
+    return {
+        "a_q": factor(ks[0], (nl, n_adapters, d, rank)),
+        "b_q": factor(ks[1], (nl, n_adapters, rank, hk)),
+        "a_mlp": factor(ks[2], (nl, n_adapters, d, rank)),
+        "b_mlp": factor(ks[3], (nl, n_adapters, rank, f)),
+    }
+
+
+def _lora_delta(h_in, a, b):
+    """Per-row low-rank delta: activations ``h_in`` (B, T, D) through
+    each row's gathered adapter factors ``a`` (B, D, r), ``b``
+    (B, r, O) -> (B, T, O). Two thin einsums (rank r contraction) —
+    decode-step cost is O(B*r*(D+O)), noise next to the weight
+    stream."""
+    u = jnp.einsum("btd,bdr->btr", h_in, a.astype(h_in.dtype))
+    return jnp.einsum("btr,bro->bto", u, b.astype(h_in.dtype))
 
 
 def transformer_apply(
@@ -879,7 +969,7 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
             kv_all = kv_all.at[i, plane, bidx, pos].set(rows[plane])
         return kv_all
 
-    def block_decode(x, p, kv_all, i, pos):
+    def block_decode(x, p, kv_all, i, pos, lora=None, adapter=None):
         # x: (B, D) one position; kv_all: the ONE stacked packed cache
         # (nl, 2, B, Tpad, Hkv*K) (axis 1: K then V) — this layer writes
         # its new K and V rows with a single dynamic_update_slice and
@@ -895,9 +985,11 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
             # the dense fallback IS the C=1 chunk block — one code path
             # (no separate copy to drift), used under SPMD sharding,
             # for debugging, and as speculative decoding's
-            # numerics-matched draft mode
+            # numerics-matched draft mode — and batched LoRA's decode
+            # path (adapter deltas ride the same chunk block)
             y, kv_all = _block_chunk(
-                cfg, x[:, None, :], p, kv_all, i, pos, tp_mesh=tp_mesh
+                cfg, x[:, None, :], p, kv_all, i, pos, tp_mesh=tp_mesh,
+                lora=lora, adapter=adapter,
             )
             return y[:, 0], kv_all
         b = x.shape[0]
@@ -975,7 +1067,7 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
             x = x + _mlp(p, h_in)
         return x, kv_all
 
-    def forward_one(params, caches, token, pos):
+    def forward_one(params, caches, token, pos, adapter=None):
         """One position through all layers; returns (logits, caches).
 
         ``pos`` is a scalar (every batch row at the same depth — the
@@ -983,12 +1075,22 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
         per-row positions (the serving engine, where each slot decodes
         at its own depth).
 
+        ``adapter`` (B,) int rows (with a ``params["lora"]`` bank
+        present) applies batched-LoRA deltas per row — dense path only;
+        the serving engine forces ``decode_kernel=False`` when a bank
+        is loaded.
+
         The layer loop is UNROLLED (n_layers static python loop): the
         round-1 lax.scan spent a third of decode wall time in while-loop
         bookkeeping alone (measured via hlo_stats), and its cache carry
         defeated in-place updates.
         """
         kv_all = caches
+        lora = params.get("lora") if adapter is not None else None
+        if lora is not None and cfg.decode_kernel:
+            raise ValueError(
+                "batched LoRA decode requires decode_kernel=False"
+            )
         # explicit clamp, matching forward_chunk's mode='clip': the
         # speculative draft legitimately calls at pos up to total+k-2
         # (scratch slots whose outputs are discarded) and must not rely
@@ -999,7 +1101,11 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
         )
         for i in range(cfg.n_layers):
             p_i = jax.tree.map(lambda a: a[i], params["blocks"])
-            x, kv_all = block_decode(x, p_i, kv_all, i, pos)
+            l_i = (None if lora is None
+                   else jax.tree.map(lambda a: a[i], lora))
+            x, kv_all = block_decode(
+                x, p_i, kv_all, i, pos, lora=l_i, adapter=adapter
+            )
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
         # head matmul with bf16 (or dequantized-int8) OPERANDS — half/
         # quarter the weight stream and the MXU fast path; decode is
@@ -1076,7 +1182,7 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
             (nl, 2, batch, tpad, h * kd), cfg.compute_dtype
         )
 
-    def prefill(params, caches, prompt, last_idx=None):
+    def prefill(params, caches, prompt, last_idx=None, adapter=None):
         """Bulk prefill: ONE causal forward over the whole prompt fills
         every layer's KV cache and yields the last-position logits —
         the standard inference split (parallel prefill, serial decode).
@@ -1095,12 +1201,17 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
         lengths inside the same bucket; the per-row gather copies the
         same values the scalar program reads, so logits stay row-wise
         bitwise identical to B=1 prefills.
+
+        ``adapter`` (B,) int rows (with a ``params["lora"]`` bank
+        present) applies each row's batched-LoRA deltas; the bank's
+        leading layer axis scans alongside ``params["blocks"]``.
         """
         b, tp = prompt.shape
         if tp == 0:
             # empty prompt: nothing to prefill — decode starts from
             # uniform logits, as the round-1 per-position walk did
             return caches, jnp.zeros((b, cfg.vocab_size), jnp.float32)
+        lora = params.get("lora") if adapter is not None else None
         kv_all = caches  # (nl, 2, B, Tpad, Hkv*K) packed
         x = (params["embed"][prompt] + params["pos"][:tp]).astype(
             cfg.compute_dtype
@@ -1113,9 +1224,26 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
             sin_b = sin[None, None, :, :]
 
         def layer(x, xs):
-            p, kv = xs  # kv: (2, B, Tpad, Hkv*K); int8 mode: dict
+            if lora is None:
+                p, kv = xs  # kv: (2, B, Tpad, Hkv*K); int8 mode: dict
+                lo = None
+            else:
+                p, lo, kv = xs
             h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
             q, k_r, v_r = _project_qkv(cfg, p, h_in)
+            if lo is not None:
+                # same attach point as _block_chunk: q delta pre-RoPE,
+                # adapter-0 rows select the untouched base projection
+                dq = _lora_delta(
+                    h_in,
+                    jnp.take(lo["a_q"], adapter, axis=0),
+                    jnp.take(lo["b_q"], adapter, axis=0),
+                ).reshape(
+                    b, tp, cfg.n_heads, cfg.head_dim
+                ).transpose(0, 2, 1, 3)
+                q = jnp.where(
+                    (adapter > 0)[:, None, None, None], q + dq, q
+                )
             if cfg.rope:
                 q = _apply_rope(q, cos_b, sin_b)
                 k_r = _apply_rope(k_r, cos_b, sin_b)
@@ -1179,11 +1307,23 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
                     moe_params, flat, k=cfg.moe_k, activation=jax.nn.gelu
                 )
                 x = x + y.reshape(h_in.shape)
+            elif lo is not None:
+                dm = _lora_delta(
+                    h_in,
+                    jnp.take(lo["a_mlp"], adapter, axis=0),
+                    jnp.take(lo["b_mlp"], adapter, axis=0),
+                )
+                x = x + _mlp(p, h_in, tp_mesh, delta1=dm,
+                             sel=(adapter > 0)[:, None, None])
             else:
                 x = x + _mlp(p, h_in, tp_mesh)
             return x, kv
 
-        x, kv_all = lax.scan(layer, x, (params["blocks"], kv_all))
+        if lora is None:
+            xs = (params["blocks"], kv_all)
+        else:
+            xs = (params["blocks"], lora, kv_all)
+        x, kv_all = lax.scan(layer, x, xs)
         if last_idx is None:
             x_last = x[:, -1]
         elif jnp.ndim(last_idx) == 1:
@@ -1372,7 +1512,7 @@ def _filtered_probs(logits, temperature: float, top_k: int | None,
 
 
 def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0,
-                 tp_mesh=None):
+                 tp_mesh=None, lora=None, adapter=None):
     """One transformer block over C consecutive cached-decode positions
     (x: (B, C, D), rows pos0..pos0+C-1): projection, RoPE, cache write,
     dense masked attention against the cache, MLP/MoE tail. ONE
@@ -1380,7 +1520,14 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0,
     (C=1) and the speculative verify chunk — the dense decode numerics
     cannot drift from the verify numerics because they are the same
     code. ``pos0`` is a scalar start position or an (B,) vector of
-    per-row starts (the serving engine's per-slot decode depths)."""
+    per-row starts (the serving engine's per-slot decode depths).
+
+    ``lora`` (this layer's slice of an :func:`init_lora_bank` bank —
+    leaves (N, ...)) with ``adapter`` (B,) int rows adds each row's
+    low-rank q and MLP deltas, gathered by adapter index inside the
+    traced program so one dispatch serves mixed adapters. Rows with
+    adapter 0 SELECT the untouched base activations (``jnp.where``,
+    not an add of zeros) so their output is bitwise the base model's."""
     b, c, _ = x.shape
     kd = cfg.head_dim
     grp = cfg.n_heads // cfg.kv_heads
@@ -1389,6 +1536,15 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0,
     positions = (pos0[:, None] if vec_pos else pos0) + jnp.arange(c)
     h_in = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     q, k_r, v_r = _project_qkv(cfg, p, h_in)  # (B,H,C,K), (B,Hkv,C,K)
+    if lora is not None:
+        # q delta BEFORE RoPE — where a merged wq+AB would land it, so
+        # a slot's stream matches a single-adapter engine's flop order
+        dq = _lora_delta(
+            h_in,
+            jnp.take(lora["a_q"], adapter, axis=0),
+            jnp.take(lora["b_q"], adapter, axis=0),
+        ).reshape(b, c, cfg.n_heads, kd).transpose(0, 2, 1, 3)
+        q = jnp.where((adapter > 0)[:, None, None, None], q + dq, q)
     if cfg.rope:
         cos, sin = _rope_tables(positions, cfg.head_dim, x.dtype)
         if vec_pos:  # (B, C, hd/2): per-row tables over the head axis
@@ -1481,6 +1637,14 @@ def _block_chunk(cfg: TransformerConfig, x, p, kv_all, i, pos0,
             moe_params, flat, k=cfg.moe_k, activation=jax.nn.gelu
         )
         x = x + y.reshape(h_in.shape)
+    elif lora is not None:
+        dm = _lora_delta(
+            h_in,
+            jnp.take(lora["a_mlp"], adapter, axis=0),
+            jnp.take(lora["b_mlp"], adapter, axis=0),
+        )
+        x = x + _mlp(p, h_in, tp_mesh, delta1=dm,
+                     sel=(adapter > 0)[:, None, None])
     else:
         x = x + _mlp(p, h_in, tp_mesh)
     return x, kv_all
@@ -1496,7 +1660,8 @@ def _chunk_builder(cfg: TransformerConfig, tp_mesh=None):
     Per-layer work delegates to :func:`_block_chunk` — the same code
     ``block_decode``'s non-kernel path runs at C=1."""
 
-    def forward_chunk(params, caches, toks, pos0, last_idx=None):
+    def forward_chunk(params, caches, toks, pos0, last_idx=None,
+                      adapter=None):
         b, c = toks.shape
         # per-index clip: positions past max_len (possible only for
         # slots whose outputs are discarded at the buffer slice) clamp
@@ -1508,10 +1673,14 @@ def _chunk_builder(cfg: TransformerConfig, tp_mesh=None):
             cfg.compute_dtype
         )
         kv_all = caches
+        lora = params.get("lora") if adapter is not None else None
         for i in range(cfg.n_layers):
             p_i = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+            l_i = (None if lora is None
+                   else jax.tree.map(lambda a, i=i: a[i], lora))
             x, kv_all = _block_chunk(
-                cfg, x, p_i, kv_all, i, pos0, tp_mesh=tp_mesh
+                cfg, x, p_i, kv_all, i, pos0, tp_mesh=tp_mesh,
+                lora=l_i, adapter=adapter,
             )
         if last_idx is not None:
             # single-row logits (bucketed-prefill chunking: only the

@@ -31,6 +31,13 @@ Public surface:
   front end over N engine replicas: prefix-affinity dispatch via a
   shadow token trie, least-loaded otherwise, per-replica health with
   retry onto survivors (``router`` subcommand).
+- :class:`~deeplearning4j_tpu.serving.tenancy.TenantRegistry` /
+  :class:`~deeplearning4j_tpu.serving.tenancy.TenantConfig` —
+  multi-tenant serving: API-key resolution, per-tenant priority /
+  deficit-round-robin weight / KV-slot cap / token-rate quota
+  (:class:`~deeplearning4j_tpu.serving.tenancy.QuotaExceeded` → 429)
+  and a default batched-LoRA adapter
+  (``models.transformer.init_lora_bank``) per tenant.
 """
 
 from deeplearning4j_tpu.serving.cache_pool import KVSlotPool  # noqa: F401
@@ -50,8 +57,14 @@ from deeplearning4j_tpu.serving.router import ReplicaRouter  # noqa: F401
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Backpressure,
+    EmbeddingRequest,
     Request,
     RequestScheduler,
     RequestStatus,
 )
 from deeplearning4j_tpu.serving.server import ServingServer  # noqa: F401
+from deeplearning4j_tpu.serving.tenancy import (  # noqa: F401
+    QuotaExceeded,
+    TenantConfig,
+    TenantRegistry,
+)

@@ -155,11 +155,13 @@ from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache, Segment
 from deeplearning4j_tpu.serving.probe_cache import ProbeCache, probe_key
 from deeplearning4j_tpu.serving.scheduler import (
+    AdmissionError,
     Backpressure,
     Request,
     RequestScheduler,
     RequestStatus,
 )
+from deeplearning4j_tpu.serving.tenancy import QuotaExceeded
 
 #: device EOS id for requests without one (never equals a sampled token)
 _NO_EOS = -1
@@ -171,9 +173,10 @@ class _SlotState:
     """Host-side record for one occupied slot."""
 
     __slots__ = ("req", "tokens", "t_first_token", "gen", "key_data",
-                 "segs")
+                 "adapter", "segs")
 
-    def __init__(self, req: Request, gen: int, key_data):
+    def __init__(self, req: Request, gen: int, key_data,
+                 adapter: int = 0):
         self.req = req
         self.tokens: list[int] = []
         self.t_first_token: float | None = None
@@ -181,6 +184,9 @@ class _SlotState:
         # raw uint32 data of the slot's sampling key (host-persisted so
         # crash-recovery replay resumes the exact key stream)
         self.key_data = key_data
+        # LoRA bank row (host-persisted so recovery replays through the
+        # same adapter weights)
+        self.adapter = adapter
         # prefix-cache segments this request pins (the one its
         # admission read + the one its prompt inserted); unpinned at
         # retirement so LRU eviction can reclaim them
@@ -289,6 +295,10 @@ class ServingEngine:
         tp: int = 1,
         tp_parity: bool | str = "auto",
         probe_cache: str | ProbeCache | None = None,
+        lora_bank=None,
+        lora_parity: bool | str = "auto",
+        tenancy=None,
+        embedders=None,
     ):
         self.n_slots = n_slots
         self.max_total = int(min(max_total or cfg.max_len, cfg.max_len))
@@ -303,6 +313,35 @@ class ServingEngine:
         )
         self.probes_run: list[str] = []
         self.probes_from_cache: list[str] = []
+        # batched LoRA: the adapter bank (init_lora_bank pytree) rides
+        # inside params under the "lora" key; each slot carries an
+        # adapter INDEX as traced data, so one compiled step serves
+        # every adapter mix (no per-adapter program families). Row 0 is
+        # the zero adapter — the forward SELECTS the untouched base
+        # activations for it (jnp.where, not +0.0), so adapter-0 output
+        # is bitwise the base model; lora_parity "auto" probes exactly
+        # that once (verdict persisted via probe_cache) and drops the
+        # bank on mismatch, as tp_parity falls back to tp=1.
+        self.lora_bank = None
+        self.n_adapters = 0
+        if lora_bank is not None and lora_parity is not False:
+            self.lora_bank = lora_bank
+            self.n_adapters = int(
+                jax.tree.leaves(lora_bank)[0].shape[1]
+            )
+            if cfg.decode_kernel:
+                # the Pallas decode kernel has no adapter-gather path;
+                # the dense fallback is the same numerics (see
+                # block_decode)
+                cfg = dataclasses.replace(cfg, decode_kernel=False)
+        # multi-tenant serving config (see serving.tenancy): resolves
+        # per-tenant slot caps at admission; quota charging happens in
+        # the scheduler's submit
+        self.tenancy = tenancy
+        # host-side embedding tables (name -> object with
+        # embedding(word)) served at admission boundaries without a KV
+        # slot — the scheduler/metrics/drain machinery is model-agnostic
+        self.embedders = dict(embedders or {})
         # tensor parallelism: resolve the mesh BEFORE anything compiles.
         # tp > 1 shards the whole hot path — params per
         # serving_tp_shardings (exact head/column layout), the KV pool
@@ -367,6 +406,13 @@ class ServingEngine:
         self._init_caches = init_caches
         self._do_prefill = do_prefill
         self._fwd_chunk = _chunk_builder(cfg, tp_mesh=self.tp_mesh)
+        if self.lora_bank is not None:
+            # the bank travels inside params: place_serving_tp_params
+            # shards it with the column layout (A replicated, B sharded
+            # on the output dim) and cast_params passes it through —
+            # _lora_delta casts at use, so the bank stays f32 at rest
+            params = dict(params)
+            params["lora"] = self.lora_bank
         if self.tp_mesh is not None:
             # shard the weights over the mesh (exact head/column
             # layout) before the cast — the cast is elementwise, so it
@@ -376,15 +422,38 @@ class ServingEngine:
         # program; hoisting it out of the per-step program keeps every
         # step from re-casting — same values, cast is deterministic)
         self.params = jax.jit(cast_params)(params)
+        if self.lora_bank is not None and lora_parity is not True:
+            ok = self._probe_verdict(
+                "lora_zero", self._probe_lora_zero,
+                n_adapters=self.n_adapters, tp=self.tp,
+                max_total=self.max_total,
+            )
+            if not ok:
+                # serve base-only rather than risk perturbing adapter-0
+                # traffic (cfg.decode_kernel stays off — same numerics,
+                # see block_decode)
+                log_event(_log, "lora_parity_probe_failed",
+                          n_adapters=self.n_adapters)
+                self.params = {
+                    k: v for k, v in self.params.items() if k != "lora"
+                }
+                self.lora_bank = None
+                self.n_adapters = 0
 
         self.pool = KVSlotPool(
             cfg, n_slots, self.max_total,
             sharding=(serving_tp_cache_sharding(self.tp_mesh, cfg)
                       if self.tp_mesh is not None else None),
         )
-        self.scheduler = scheduler or RequestScheduler(
-            max_total_tokens=self.max_total,
-            prefix_affinity_tokens=prefix_affinity_tokens,
+        # NOT `scheduler or ...`: RequestScheduler defines __len__, so
+        # a caller's (normally empty) scheduler would be falsy and
+        # silently swapped for a default one, dropping its knobs
+        self.scheduler = scheduler if scheduler is not None else (
+            RequestScheduler(
+                max_total_tokens=self.max_total,
+                prefix_affinity_tokens=prefix_affinity_tokens,
+                tenancy=tenancy,
+            )
         )
         if self.scheduler.max_total_tokens is None:
             self.scheduler.max_total_tokens = self.max_total
@@ -454,6 +523,13 @@ class ServingEngine:
         self._slot_keys = np.zeros(
             (n_slots,) + _kd0.shape, _kd0.dtype
         )
+        # per-slot LoRA adapter indices, host-side mirror of
+        # _slot_keys: written at admission, snapshotted (copied) per
+        # dispatch, re-seated from _SlotState records at recovery.
+        # Always threaded into the compiled programs — with no bank the
+        # traced vector is unused and folds out of the graph, so the
+        # program count and numerics are unchanged.
+        self._slot_adapters = np.zeros((n_slots,), np.int32)
         self._steps = 0
         self._admitting = 0  # requests between scheduler pop and slot
         self.last_dispatch_t: float | None = None  # watchdog heartbeat
@@ -530,6 +606,15 @@ class ServingEngine:
             "serve_queue_depth", "Requests queued, not yet admitted.",
         ).set_function(lambda: len(self.scheduler))
         reg.gauge(
+            "serve_lora_adapters",
+            "Rows in the batched-LoRA adapter bank (0 = base only; "
+            "row 0 is always the zero adapter).",
+        ).set_function(lambda: self.n_adapters)
+        if self.tenancy is not None:
+            reg.gauge(
+                "serve_tenants", "Configured tenants in the registry.",
+            ).set_function(lambda: len(self.tenancy))
+        reg.gauge(
             "serve_decode_horizon_current",
             "Decode substeps fused into the next horizon dispatch "
             "(shrinks to 1 under adaptive_horizon while the queue is "
@@ -589,7 +674,7 @@ class ServingEngine:
         approx_top_k = self.approx_top_k
 
         def step(params, caches, logits, pos, active, budget, eos,
-                 slot_keys_raw):
+                 slot_keys_raw, adapters):
             # per-slot keys (raw uint32 rows, host-persisted): token i
             # of slot s is sampled with fold_in(key_s, position) — a
             # pure function of the slot's admission key and its stream
@@ -614,7 +699,9 @@ class ServingEngine:
                 # write stays inside their own slab and is wiped by the
                 # next admission's prefill insert
                 toks = jnp.where(active, toks, 0)
-                new_logits, caches = fwd1(params, caches, toks, pos)
+                new_logits, caches = fwd1(
+                    params, caches, toks, pos, adapter=adapters
+                )
                 # advance only live slots, then deactivate in-program:
                 # a slot that just emitted EOS or spent its budget
                 # stops mutating for the rest of the horizon
@@ -635,8 +722,11 @@ class ServingEngine:
         must stay exactly what the slot's last real step produced."""
         fwd1 = self._fwd1
 
-        def rstep(params, caches, logits, toks, pos, replaying):
-            new_logits, caches = fwd1(params, caches, toks, pos)
+        def rstep(params, caches, logits, toks, pos, replaying,
+                  adapters):
+            new_logits, caches = fwd1(
+                params, caches, toks, pos, adapter=adapters
+            )
             logits = jnp.where(replaying[:, None], new_logits, logits)
             return caches, logits
 
@@ -655,7 +745,7 @@ class ServingEngine:
 
             def prefill(caches, logits, pos, active, budget, eos,
                         params, prompt, last_idx, slot, pos0, max_new,
-                        eos_tok):
+                        eos_tok, adapter):
                 # batch-1 prefill into a scratch single-slot cache of
                 # the SAME Tpad as the pool, then insert the slab at
                 # the slot index. The slab copy includes the zero rows
@@ -666,7 +756,7 @@ class ServingEngine:
                 # logits are bitwise those of an exact-length prefill.
                 tmp, lg = do_prefill(
                     params, init_caches(1, max_total), prompt,
-                    last_idx=last_idx,
+                    last_idx=last_idx, adapter=adapter,
                 )
                 caches = jax.tree.map(
                     lambda c, t: lax.dynamic_update_slice(
@@ -693,9 +783,10 @@ class ServingEngine:
         if fn is None:
             fwd_chunk = self._fwd_chunk
 
-            def chunk(params, tmp, toks, pos0, last_idx):
+            def chunk(params, tmp, toks, pos0, last_idx, adapter):
                 lg, tmp = fwd_chunk(
-                    params, tmp, toks, pos0, last_idx=last_idx
+                    params, tmp, toks, pos0, last_idx=last_idx,
+                    adapter=adapter,
                 )
                 return tmp, lg
 
@@ -842,10 +933,10 @@ class ServingEngine:
 
             def bprefill(caches, logits, pos, active, budget, eos,
                          params, prompts, last_idx, slots, pos0,
-                         max_new, eos_toks):
+                         max_new, eos_toks, adapters):
                 tmp, lg = do_prefill(
                     params, init_caches(nb, max_total), prompts,
-                    last_idx=last_idx,
+                    last_idx=last_idx, adapter=adapters,
                 )
                 for r in range(nb):
                     slab = jax.tree.map(
@@ -884,12 +975,13 @@ class ServingEngine:
 
             def bhit(caches, logits, pos, active, budget, eos, params,
                      region, seg_idx, toks, p0, last_idx, slots, posf,
-                     max_new, eos_toks):
+                     max_new, eos_toks, adapters):
                 tmp = jax.tree.map(
                     lambda r_: jnp.take(r_, seg_idx, axis=2), region
                 )
                 lg, tmp = fwd_chunk(
-                    params, tmp, toks, p0, last_idx=last_idx
+                    params, tmp, toks, p0, last_idx=last_idx,
+                    adapter=adapters,
                 )
                 for r in range(nb):
                     slab = jax.tree.map(
@@ -973,19 +1065,31 @@ class ServingEngine:
 
     def submit(self, req: Request) -> str:
         """Queue a request (see ``RequestScheduler.submit`` for the
-        backpressure/admission contract)."""
+        backpressure/admission contract). Rejections are labelled per
+        tenant and per reason (quota vs queue depth) in the metrics."""
+        if req.adapter >= max(1, self.n_adapters):
+            raise AdmissionError(
+                f"request {req.id}: adapter {req.adapter} outside the "
+                f"loaded bank ({self.n_adapters} adapters)"
+            )
         try:
             rid = self.scheduler.submit(req)
-        except Backpressure:
+        except Backpressure as e:
+            reason = ("quota" if isinstance(e, QuotaExceeded)
+                      else "backpressure")
             self.metrics.record_backpressure()
+            self.metrics.record_rejection(reason, tenant=req.tenant_id)
             self.tracer.instant(
                 SCHEDULER_TRACK, "backpressure", req_id=req.id
             )
+            log_event(_log, "request_rejected", level=logging.DEBUG,
+                      req_id=req.id, reason=reason,
+                      tenant=req.tenant_id or None)
             raise
         self.tracer.instant(SCHEDULER_TRACK, "submit", req_id=rid)
         log_event(_log, "request_submitted", level=logging.DEBUG,
                   req_id=rid, prompt_len=len(req.prompt),
-                  max_new=req.max_new)
+                  max_new=req.max_new, tenant=req.tenant_id or None)
         return rid
 
     @property
@@ -1065,9 +1169,10 @@ class ServingEngine:
             self.metrics.record_finished(
                 req.id, len(st.tokens),
                 now - (st.t_first_token or now),
+                tenant=req.tenant_id,
             )
         else:
-            self.metrics.record_outcome(status)
+            self.metrics.record_outcome(status, tenant=req.tenant_id)
         self.pool.release(slot)
         if self.prefix_cache is not None:
             for seg in st.segs:
@@ -1082,7 +1187,9 @@ class ServingEngine:
         )
         log_event(_log, "request_retired", req_id=req.id, slot=slot,
                   status=status.value, n_tokens=len(st.tokens),
-                  error=error)
+                  error=error, tenant=req.tenant_id or None)
+        if req.stream is not None:
+            req.stream.put(None)  # end-of-stream sentinel
         if req.done is not None:
             req.done.set()
 
@@ -1091,17 +1198,57 @@ class ServingEngine:
         """Terminal status for a request that never got a slot."""
         req.status = status
         req.error = error
-        self.metrics.record_outcome(status)
+        self.metrics.record_outcome(status, tenant=req.tenant_id)
         self.tracer.instant(
             SCHEDULER_TRACK, status.value, req_id=req.id
         )
         log_event(_log, "request_retired", req_id=req.id, slot=None,
-                  status=status.value, n_tokens=0, error=error)
+                  status=status.value, n_tokens=0, error=error,
+                  tenant=req.tenant_id or None)
+        if req.stream is not None:
+            req.stream.put(None)  # end-of-stream sentinel
         if req.done is not None:
             req.done.set()
 
     def _finish(self, slot: int, now: float) -> None:
         self._retire(slot, RequestStatus.FINISHED, now)
+
+    def _serve_embedding(self, req, now: float) -> None:
+        """Serve an :class:`EmbeddingRequest` host-side at the
+        admission boundary: no KV slot, no device dispatch — a zoo
+        embedding model's table lookup — but the full request
+        lifecycle (scheduler pop, per-tenant metrics, logs, ``done``),
+        proving the serving machinery is model-agnostic."""
+        t0 = time.perf_counter()
+        emb = self.embedders.get(req.model)
+        if emb is None:
+            req.status = RequestStatus.FAILED
+            req.error = (
+                f"unknown embedding model {req.model!r} "
+                f"(loaded: {sorted(self.embedders) or 'none'})"
+            )
+            self.metrics.record_outcome(RequestStatus.FAILED)
+        else:
+            vectors = {}
+            for w in req.words:
+                v = emb.get_word_vector(w)
+                vectors[w] = None if v is None else np.asarray(v)
+            req.result = vectors
+            req.status = RequestStatus.FINISHED
+            self.metrics.record_embedding(
+                req.model, len(req.words),
+                time.perf_counter() - t0, tenant=req.tenant_id,
+            )
+        self.tracer.instant(
+            SCHEDULER_TRACK, "embedding", req_id=req.id,
+            model=req.model, n_words=len(req.words),
+        )
+        log_event(_log, "request_retired", req_id=req.id, slot=None,
+                  status=req.status.value, n_tokens=0,
+                  error=req.error, tenant=req.tenant_id or None,
+                  kind="embedding")
+        if req.done is not None:
+            req.done.set()
 
     def _slot_of(self, req_id: str | None) -> int | None:
         if req_id is None:
@@ -1130,13 +1277,17 @@ class ServingEngine:
     # -- admission ---------------------------------------------------------
 
     def _prefill_into_state(self, state, seq: np.ndarray, slot: int,
-                            budget: int, eos_tok: int):
+                            budget: int, eos_tok: int,
+                            adapter: int = 0):
         """Land ``seq`` in ``slot`` of a pool-shaped ``state`` tuple
         through the bucketed prefill path and return the new state
         (pure w.r.t. engine attributes — the parity probes run it on
         scratch state). Dispatches O(1) programs for bucket-sized
-        sequences and O(len/bucket) on the chunked long-prompt path."""
+        sequences and O(len/bucket) on the chunked long-prompt path.
+        ``adapter`` selects the LoRA bank row (traced data, so every
+        adapter shares the bucket's one compiled program)."""
         n = int(len(seq))
+        ad = jnp.asarray([adapter], jnp.int32)
         if n == 0:
             # empty prompt: decode starts from uniform logits over a
             # zeroed slab, as the unbucketed prefill did
@@ -1154,7 +1305,7 @@ class ServingEngine:
             return self._prefill_fn(b)(
                 *state, self.params, jnp.asarray(pad), jnp.int32(n - 1),
                 jnp.int32(slot), jnp.int32(n), jnp.int32(budget),
-                jnp.int32(eos_tok),
+                jnp.int32(eos_tok), ad,
             )
         # chunked: walk the prompt through forward_chunk at bucket
         # sizes over a batch-1 scratch cache, then one slab insert —
@@ -1167,7 +1318,7 @@ class ServingEngine:
             pad[0, :ln] = seq[t0:t0 + ln]
             tmp, lg = self._chunk_fn(b)(
                 self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
-                jnp.int32(ln - 1),
+                jnp.int32(ln - 1), ad,
             )
             self.prefill_dispatches += 1
         return self._insert()(
@@ -1184,13 +1335,14 @@ class ServingEngine:
          self._dbudget, self._deos) = out
 
     def _prefill_seq_into_slot(self, seq: np.ndarray, slot: int,
-                               budget: int, eos_tok: int) -> None:
+                               budget: int, eos_tok: int,
+                               adapter: int = 0) -> None:
         """Land ``seq`` (prompt, or prompt+replayed tokens) in ``slot``
         through the bucketed prefill path and set the slot's device
         state: position len(seq), active, ``budget`` tokens
         remaining."""
         self._set_state(self._prefill_into_state(
-            self._state(), seq, slot, budget, eos_tok
+            self._state(), seq, slot, budget, eos_tok, adapter
         ))
 
     def _check_prefill_faults(self, req: Request) -> bool:
@@ -1289,7 +1441,7 @@ class ServingEngine:
                 pad[0, :ln] = seq[t0:t0 + ln]
                 tmp, lg = self._chunk_fn(b)(
                     self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
-                    jnp.int32(ln - 1),
+                    jnp.int32(ln - 1), jnp.zeros((1,), jnp.int32),
                 )
             sc = self._insert()(
                 *self._scratch_state(), tmp, lg, jnp.int32(0),
@@ -1338,6 +1490,7 @@ class ServingEngine:
                 jnp.asarray([n0, n1], np.int32),
                 jnp.asarray([3, 2], np.int32),
                 jnp.asarray([_NO_EOS, _NO_EOS], np.int32),
+                jnp.zeros((2,), jnp.int32),
             )
             if not self._states_equal(sa, sb):
                 return False
@@ -1368,7 +1521,7 @@ class ServingEngine:
                 pad[0, :ln] = sfx[r]
                 tmp, lg = self._chunk_fn(bs)(
                     self.params, tmp, jnp.asarray(pad), jnp.int32(L),
-                    jnp.int32(ln - 1),
+                    jnp.int32(ln - 1), jnp.zeros((1,), jnp.int32),
                 )
                 sh = self._insert()(
                     *sh, tmp, lg, jnp.int32(r), jnp.int32(L + ln),
@@ -1386,6 +1539,7 @@ class ServingEngine:
                 jnp.asarray([L + ln for ln in lns], np.int32),
                 jnp.asarray([2, 2], np.int32),
                 jnp.asarray([_NO_EOS, _NO_EOS], np.int32),
+                jnp.zeros((2,), jnp.int32),
             )
             return self._states_equal(sh, sbh)
         finally:
@@ -1469,6 +1623,52 @@ class ServingEngine:
             return False
         return all(np.array_equal(a, b) for a, b in zip(ref, tpo))
 
+    def _probe_lora_zero(self) -> bool:
+        """One-time probe gating batched LoRA — the bank-attach mirror
+        of ``tp_parity``: with the bank riding in params, does adapter
+        index 0 reproduce, bitwise, the bank-free base model through
+        prefill + greedy decode? The forward SELECTS the base
+        activations for adapter-0 rows (``jnp.where``, never ``+ 0.0``
+        — adding a zero delta could flip ``-0.0`` sign bits), so this
+        should pass on any backend; the probe is the standing bar that
+        proves it on THIS one. Bitwise-equal logits make greedy AND
+        sampled adapter-0 streams identical to base (sampling is a pure
+        function of logits, slot key and position)."""
+        total = int(min(self.max_total, 32))
+        n = min(8, total - 4)
+        if n < 1:
+            return False
+        seq = ((1 + np.arange(n)) % self.cfg.vocab_size).astype(np.int32)
+        prompt = jnp.asarray(seq[None])
+        base = {k: v for k, v in self.params.items() if k != "lora"}
+        ad = jnp.zeros((1,), jnp.int32)
+
+        def stream(p):
+            caches, logits = jax.jit(self._do_prefill)(  # lint: retrace-ok one-shot parity probe
+                p, self._init_caches(1, total), prompt, adapter=ad
+            )
+            out = [np.asarray(logits)]
+            pos = jnp.full((1,), n, jnp.int32)
+            step = jax.jit(  # lint: retrace-ok one-shot parity probe
+                lambda pp, c, lg, po: self._fwd1(
+                    pp, c, jnp.argmax(lg, axis=-1).astype(jnp.int32),
+                    po, adapter=ad,
+                )
+            )
+            for _ in range(3):
+                logits, caches = step(p, caches, logits, pos)
+                pos = pos + 1
+                out.append(np.asarray(logits))
+            return out
+
+        try:
+            ref = stream(base)
+            lz = stream(self.params)
+        except Exception as e:  # pragma: no cover - backend-specific
+            log_event(_log, "lora_parity_probe_error", error=repr(e))
+            return False
+        return all(np.array_equal(a, b) for a, b in zip(ref, lz))
+
     def _prefix_reuse_ok(self) -> bool:
         if self.prefix_cache is None:
             return False
@@ -1514,7 +1714,12 @@ class ServingEngine:
         slot's admission read."""
         cache = self.prefix_cache
         n = len(pl.req.prompt)
-        if (cache is None or n == 0 or not self._prefix_reuse_ok()):
+        # adapter != 0 prompts are NOT cacheable or reusable: the MLP
+        # delta makes every later layer's KV rows adapter-dependent, so
+        # segments are base-model-only and nonzero adapters always take
+        # the full prefill path
+        if (cache is None or n == 0 or pl.req.adapter != 0
+                or not self._prefix_reuse_ok()):
             return
         seg, m = cache.lookup(pl.req.prompt)
         if seg is None:
@@ -1570,6 +1775,7 @@ class ServingEngine:
             tmp, lg = self._chunk_fn(b)(
                 self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
                 jnp.int32(ln - 1),
+                jnp.asarray([req.adapter], jnp.int32),
             )
             self.prefill_dispatches += 1
         self._set_state(self._insert()(
@@ -1599,6 +1805,7 @@ class ServingEngine:
         pos0 = np.zeros((nb,), np.int32)
         max_new = np.zeros((nb,), np.int32)
         eos_toks = np.full((nb,), _NO_EOS, np.int32)
+        adapters = np.zeros((nb,), np.int32)
         for r, pl in enumerate(rows):
             n = len(pl.req.prompt)
             prompts[r, :n] = pl.req.prompt
@@ -1608,12 +1815,13 @@ class ServingEngine:
             max_new[r] = pl.req.max_new
             if pl.req.eos_token is not None:
                 eos_toks[r] = int(pl.req.eos_token)
+            adapters[r] = pl.req.adapter
         self.prefill_dispatches += 1
         self._set_state(self._batch_prefill_fn(bucket, nb)(
             *self._state(), self.params, jnp.asarray(prompts),
             jnp.asarray(last_idx), jnp.asarray(slots),
             jnp.asarray(pos0), jnp.asarray(max_new),
-            jnp.asarray(eos_toks),
+            jnp.asarray(eos_toks), jnp.asarray(adapters),
         ))
         self.metrics.record_batched_admissions(len(group))
 
@@ -1633,6 +1841,7 @@ class ServingEngine:
         posf = np.zeros((nb,), np.int32)
         max_new = np.zeros((nb,), np.int32)
         eos_toks = np.full((nb,), _NO_EOS, np.int32)
+        adapters = np.zeros((nb,), np.int32)
         for r, pl in enumerate(rows):
             n = len(pl.req.prompt)
             ln = n - L
@@ -1644,13 +1853,14 @@ class ServingEngine:
             max_new[r] = pl.req.max_new
             if pl.req.eos_token is not None:
                 eos_toks[r] = int(pl.req.eos_token)
+            adapters[r] = pl.req.adapter
         self.prefill_dispatches += 1
         self._set_state(self._batch_hit_fn(bucket, nb)(
             *self._state(), self.params, self.prefix_cache.region,
             jnp.asarray(seg_idx), jnp.asarray(toks), jnp.int32(L),
             jnp.asarray(last_idx), jnp.asarray(slots),
             jnp.asarray(posf), jnp.asarray(max_new),
-            jnp.asarray(eos_toks),
+            jnp.asarray(eos_toks), jnp.asarray(adapters),
         ))
         self.metrics.record_batched_admissions(len(group))
 
@@ -1663,7 +1873,9 @@ class ServingEngine:
         self._key, sub = jax.random.split(self._key)
         kd = np.asarray(jax.random.key_data(sub))  # lint: sync-ok per-admission key snapshot (tiny, off the decode critical section)
         self._slot_keys[slot] = kd
-        st = _SlotState(req, self.pool.generation(slot), kd)
+        self._slot_adapters[slot] = req.adapter
+        st = _SlotState(req, self.pool.generation(slot), kd,
+                        req.adapter)
         if pl.seg is not None:
             st.segs.append(pl.seg)
         self._slots[slot] = st
@@ -1673,7 +1885,8 @@ class ServingEngine:
         delay = (time.perf_counter() - req.arrival_time
                  if req.arrival_time is not None else None)
         if delay is not None:
-            self.metrics.record_admitted(req.id, delay)
+            self.metrics.record_admitted(req.id, delay,
+                                         tenant=req.tenant_id)
             self.tracer.span(
                 SCHEDULER_TRACK, "queued", req.arrival_time,
                 delay, req_id=req.id,
@@ -1687,7 +1900,9 @@ class ServingEngine:
                   slot=slot, prompt_len=len(req.prompt),
                   queue_delay_s=delay,
                   prefill_s=round(pl.prefill_s, 6),
-                  prefix=pl.kind, cached_tokens=pl.matched)
+                  prefix=pl.kind, cached_tokens=pl.matched,
+                  tenant=req.tenant_id or None,
+                  adapter=req.adapter or None)
 
     def _maybe_insert_prefix(self, pl: _AdmitPlan) -> None:
         """Insert-on-completion (of the prefill): cache the admitted
@@ -1701,7 +1916,7 @@ class ServingEngine:
         request pins every segment until retirement."""
         cache = self.prefix_cache
         n = len(pl.req.prompt)
-        if (cache is None or pl.kind == "full"
+        if (cache is None or pl.kind == "full" or pl.req.adapter != 0
                 or n < self._min_bucket or not self._prefix_reuse_ok()):
             return
         for seg in cache.insert(pl.req.prompt):
@@ -1731,14 +1946,39 @@ class ServingEngine:
         not yet seated (front of its class, original order) and
         releases its slot/segment pins before the supervisor rebuilds
         state."""
-        if not (self.pool.n_free and len(self.scheduler)):
+        if not len(self.scheduler):
+            return
+        if not (self.pool.n_free or self.scheduler.has_kind("embedding")):
             return
         self._admitting += 1
         plans: list[_AdmitPlan] = []
+        # per-tenant slot caps: live occupancy plus this batch's plans
+        # (so one admission round cannot overshoot a cap)
+        used: dict[str, int] = {}
+        if self.tenancy is not None:
+            for st in self._slots:
+                if st is not None:
+                    tid = st.req.tenant_id
+                    used[tid] = used.get(tid, 0) + 1
+
+        def admissible(r):
+            if r.kind != "generate":
+                return True  # embeddings are served host-side, slotless
+            if self.pool.n_free == 0:
+                return False
+            if self.tenancy is not None:
+                t = self.tenancy.get(r.tenant_id)
+                if (t is not None and t.max_slots is not None
+                        and used.get(r.tenant_id, 0) >= t.max_slots):
+                    return False
+            return True
+
         try:
             hint = None
-            while self.pool.n_free and len(self.scheduler):
-                req = self.scheduler.pop(affinity_hint=hint)
+            while len(self.scheduler):
+                req = self.scheduler.pop(
+                    affinity_hint=hint, admissible=admissible
+                )
                 if req is None:
                     break
                 if req.cancelled:
@@ -1747,8 +1987,14 @@ class ServingEngine:
                 if req.expired(now):
                     self._retire_unadmitted(req, RequestStatus.EXPIRED)
                     continue
+                if req.kind == "embedding":
+                    self._serve_embedding(req, now)
+                    continue
                 plans.append(_AdmitPlan(req, self.pool.acquire()))
-                hint = req.prompt
+                used[req.tenant_id] = used.get(req.tenant_id, 0) + 1
+                # prefix affinity only helps adapter-0 traffic (nonzero
+                # adapters never reuse cached segments)
+                hint = req.prompt if req.adapter == 0 else None
             if not plans:
                 return
             for pl in plans:
@@ -1837,7 +2083,8 @@ class ServingEngine:
                 eos_tok = (_NO_EOS if pl.req.eos_token is None
                            else int(pl.req.eos_token))
                 self._prefill_seq_into_slot(
-                    pl.req.prompt, pl.slot, pl.req.max_new, eos_tok
+                    pl.req.prompt, pl.slot, pl.req.max_new, eos_tok,
+                    adapter=pl.req.adapter,
                 )
             pl.t_pf, pl.prefill_s = t0, time.perf_counter() - t0
         # seat states in admission order (sampling-key split order is
@@ -1876,6 +2123,7 @@ class ServingEngine:
         # snapshot is what gets dispatched, and (under the sanitizer)
         # what gets integrity-tracked until the readback.
         keys_host = self._slot_keys.copy()
+        ad_host = self._slot_adapters.copy()
         while True:
             try:
                 if self.faults is not None:
@@ -1885,6 +2133,7 @@ class ServingEngine:
                     self.params, self.pool.caches, self._logits,
                     self._dpos, self._dactive, self._dbudget,
                     self._deos, jnp.asarray(keys_host),
+                    jnp.asarray(ad_host),
                 )
                 break
             except TransientFault as e:
@@ -1986,6 +2235,11 @@ class ServingEngine:
                             req.id, now - req.arrival_time
                         )
                 st.tokens.append(tok)
+                if req.stream is not None:
+                    # host-side fan-out for SSE: tokens already arrived
+                    # with this horizon's one readback, so streaming
+                    # costs zero extra device syncs
+                    req.stream.put(tok)
                 if (tok == req.eos_token
                         or len(st.tokens) >= req.max_new):
                     finished = True
@@ -2101,6 +2355,7 @@ class ServingEngine:
                 self.params, self.pool.caches, self._logits,
                 jnp.asarray(toks), jnp.asarray(pos.copy()),
                 jnp.asarray(replaying),
+                jnp.zeros((self.n_slots,), jnp.int32),
             )
         lb = np.asarray(self._logits[0])
         self.pool.reinit()
@@ -2158,8 +2413,10 @@ class ServingEngine:
         # with position-indexed fold_in sampling this is all it takes
         # for a temperature>0 stream to resume exactly where it left off
         self._slot_keys[:] = 0
+        self._slot_adapters[:] = 0
         for slot, st in live:
             self._slot_keys[slot] = st.key_data
+            self._slot_adapters[slot] = st.adapter
         self.last_recover_mode = (
             None if not live else ("chunked" if chunked else "stepwise")
         )
@@ -2176,7 +2433,8 @@ class ServingEngine:
                 eos_tok = (_NO_EOS if req.eos_token is None
                            else int(req.eos_token))
                 self._prefill_seq_into_slot(
-                    seq, slot, req.max_new - len(st.tokens), eos_tok
+                    seq, slot, req.max_new - len(st.tokens), eos_tok,
+                    adapter=st.adapter,
                 )
             self._log_recovered(t_rec, len(live))
             return len(live)
@@ -2186,7 +2444,8 @@ class ServingEngine:
             eos_tok = (_NO_EOS if req.eos_token is None
                        else int(req.eos_token))
             self._prefill_seq_into_slot(
-                req.prompt, slot, req.max_new, eos_tok
+                req.prompt, slot, req.max_new, eos_tok,
+                adapter=st.adapter,
             )
             pos[slot] = len(req.prompt)
         for j in range(max((len(st.tokens) for _, st in live), default=0)):
@@ -2203,6 +2462,7 @@ class ServingEngine:
                 self.params, self.pool.caches, self._logits,
                 jnp.asarray(toks), jnp.asarray(pos.copy()),
                 jnp.asarray(replaying),
+                jnp.asarray(self._slot_adapters.copy()),
             )
             for slot, st in live:
                 if j < len(st.tokens):

@@ -54,14 +54,26 @@ the series above is one K-substep horizon dispatch; TTFT is still
 measured to the host-visible first token, so it honestly includes the
 up-to-K-substeps readback lag the pipeline introduces.
 
+Multi-tenant serving adds a ``tenant`` dimension: terminal outcomes,
+generated tokens, and rejections get tenant-labelled Prometheus
+families (``serve_tenant_requests_total``/``serve_tenant_tokens_total``
+/``serve_rejections_total``), and per-tenant TPOT/queue-delay
+reservoirs feed a ``tenants`` block in ``summary()``. Single-tenant
+deployments pay nothing: the tenant state is created lazily on the
+first event that carries a non-empty tenant id, and all the unlabelled
+families above are recorded exactly as before.
+
 p50/p99 come from ``summary()``; with fewer than ~100 samples the p99
 is just the max-ish tail order statistic — fine for a bench row.
 """
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
+from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
 from deeplearning4j_tpu.obs.registry import MetricsRegistry, Reservoir
 from deeplearning4j_tpu.utils.metrics import MetricsWriter
 
@@ -120,6 +132,18 @@ class ServingMetrics:
         self.n_prefix_evictions = 0
         # admissions coalesced into shared same-bucket prefill dispatches
         self.n_batched_admissions = 0
+        # embedding requests served host-side (no KV slot)
+        self.n_embeddings = 0
+        self.embed_latency = Reservoir(reservoir_cap)
+        self._reservoir_cap = reservoir_cap
+        # per-tenant state, created lazily on the first event carrying a
+        # non-empty tenant id. HTTP handler threads record rejections
+        # while the engine thread records finishes, so creation and the
+        # exact counters move under a lock (the Prometheus counters have
+        # their own).
+        self._tlock = wrap_lock(threading.Lock(), "metrics._tlock")
+        self._tenants: dict[str, dict] = {}  # guarded-by: _tlock
+        self.n_rejections: dict[str, int] = {}  # guarded-by: _tlock
         self._step = 0
 
         # Prometheus instruments (get-or-create: a shared registry can
@@ -179,10 +203,47 @@ class ServingMetrics:
             "Admissions coalesced into shared same-bucket prefill "
             "dispatches.",
         )
+        self._c_rejections = reg.counter(
+            "serve_rejections_total",
+            "Submits shed before queueing, by reason "
+            "(backpressure|quota) and tenant.", ("reason", "tenant"),
+        )
+        self._c_tenant_requests = reg.counter(
+            "serve_tenant_requests_total",
+            "Terminal request outcomes by tenant.", ("tenant", "outcome"),
+        )
+        self._c_tenant_tokens = reg.counter(
+            "serve_tenant_tokens_total",
+            "Tokens generated per tenant.", ("tenant",),
+        )
+        self._c_embeddings = reg.counter(
+            "serve_embeddings_total",
+            "Embedding requests served, by model.", ("model",),
+        )
+        self._h_embed = reg.histogram(
+            "serve_embedding_seconds",
+            "Embedding request service time (host-side lookup).",
+        )
 
     def _emit(self, tag: str, value: float, step: int | None = None) -> None:
         if self.writer is not None:
             self.writer.scalar(f"{self.prefix}/{tag}", value, step)
+
+    def _tenant(self, tenant_id: str) -> dict:  # lint: holds _tlock
+        """Per-tenant exact counters + reservoirs. Call holding
+        ``_tlock``."""
+        st = self._tenants.get(tenant_id)
+        if st is None:
+            note_access("metrics.tenants", write=True)
+            st = self._tenants[tenant_id] = {
+                "tpot": Reservoir(self._reservoir_cap),
+                "queue_delay": Reservoir(self._reservoir_cap),
+                "n_finished": 0,
+                "n_generated": 0,
+                "n_rejected": 0,
+                "n_other": 0,
+            }
+        return st
 
     def record_phase(self, phase: str, seconds: float) -> None:
         """Attribute ``seconds`` of wall time to a request phase."""
@@ -201,13 +262,17 @@ class ServingMetrics:
         self._emit("queue_depth", queue_depth, self._step)
         self._step += 1
 
-    def record_admitted(self, req_id: str, delay_s: float) -> None:
+    def record_admitted(self, req_id: str, delay_s: float,
+                        tenant: str = "") -> None:
         """Request left the queue for a KV slot after ``delay_s``
         seconds of waiting (admission happens at horizon boundaries, so
         this is where decode_horizon > 1 shows up first)."""
         self.queue_delay.add(float(delay_s))
         self.record_phase("queue", float(delay_s))
         self._emit("queue_delay_seconds", delay_s)
+        if tenant:
+            with self._tlock:
+                self._tenant(tenant)["queue_delay"].add(float(delay_s))
 
     def record_prefill(self, req_id: str, seconds: float) -> None:
         """One admission prefill (all bucket/chunk dispatches)."""
@@ -232,18 +297,28 @@ class ServingMetrics:
         self._emit("ttft_seconds", ttft_s)
 
     def record_finished(self, req_id: str, n_tokens: int,
-                        decode_s: float) -> None:
+                        decode_s: float, tenant: str = "") -> None:
         """Request retired: ``n_tokens`` generated, ``decode_s`` wall
         seconds spent after the first token."""
         self.n_finished += 1
         self.n_generated += n_tokens
         self._c_requests.inc(outcome="finished")
         self._c_tokens.inc(n_tokens)
+        tpot = None
         if n_tokens > 1:
             tpot = decode_s / (n_tokens - 1)
             self.tpot.add(tpot)
             self._h_tpot.observe(tpot)
             self._emit("tpot_seconds", tpot)
+        if tenant:
+            self._c_tenant_requests.inc(tenant=tenant, outcome="finished")
+            self._c_tenant_tokens.inc(n_tokens, tenant=tenant)
+            with self._tlock:
+                st = self._tenant(tenant)
+                st["n_finished"] += 1
+                st["n_generated"] += n_tokens
+                if tpot is not None:
+                    st["tpot"].add(tpot)
 
     def record_retry(self) -> None:
         """One transient-fault retry at an engine boundary."""
@@ -261,6 +336,32 @@ class ServingMetrics:
         """One submit shed at max queue depth."""
         self.n_backpressure += 1
         self._c_backpressure.inc()
+
+    def record_rejection(self, reason: str, tenant: str = "") -> None:
+        """One submit shed before queueing, with its reason
+        (``backpressure`` = queue depth, ``quota`` = tenant token
+        bucket dry). Recorded ALONGSIDE :meth:`record_backpressure`
+        — that unlabelled counter keeps its pre-tenancy meaning while
+        this family adds the reason/tenant breakdown."""
+        self._c_rejections.inc(reason=reason, tenant=tenant)
+        with self._tlock:
+            self.n_rejections[reason] = self.n_rejections.get(reason, 0) + 1
+            if tenant:
+                self._tenant(tenant)["n_rejected"] += 1
+
+    def record_embedding(self, model: str, n_words: int,
+                         seconds: float, tenant: str = "") -> None:
+        """One embedding request served host-side (``n_words`` lookups
+        against the ``model`` embedder, no KV slot involved)."""
+        self.n_embeddings += 1
+        self.embed_latency.add(float(seconds))
+        self._c_embeddings.inc(model=model)
+        self._h_embed.observe(seconds)
+        self._emit("embedding_seconds", seconds)
+        if tenant:
+            self._c_tenant_requests.inc(tenant=tenant, outcome="embedding")
+            with self._tlock:
+                self._tenant(tenant)["n_finished"] += 1
 
     def record_prefix_lookup(self, result: str, saved_tokens: int) -> None:
         """One admission-time prefix-cache lookup. ``result`` is
@@ -296,11 +397,15 @@ class ServingMetrics:
         self.n_batched_admissions += int(n)
         self._c_batched.inc(int(n))
 
-    def record_outcome(self, status) -> None:
+    def record_outcome(self, status, tenant: str = "") -> None:
         """Non-FINISHED terminal outcome (status is a
         ``RequestStatus`` or its string value)."""
         s = getattr(status, "value", status)
         self._c_requests.inc(outcome=s)
+        if tenant:
+            self._c_tenant_requests.inc(tenant=tenant, outcome=s)
+            with self._tlock:
+                self._tenant(tenant)["n_other"] += 1
         if s == "failed":
             self.n_failed += 1
             self._emit("failed_total", self.n_failed)
@@ -343,6 +448,32 @@ class ServingMetrics:
             out["prefix_evictions"] = self.n_prefix_evictions
         if self.n_batched_admissions:
             out["batched_admissions"] = self.n_batched_admissions
+        if self.n_embeddings:
+            out["n_embeddings"] = self.n_embeddings
+            out["embedding_p50_s"] = _pct(self.embed_latency, 50)
+        with self._tlock:
+            if self.n_rejections:
+                out["rejections"] = dict(self.n_rejections)
+            if self._tenants:
+                tenants = {}
+                for tid in sorted(self._tenants):
+                    st = self._tenants[tid]
+                    t = {
+                        "n_finished": st["n_finished"],
+                        "n_generated": st["n_generated"],
+                    }
+                    if st["n_rejected"]:
+                        t["n_rejected"] = st["n_rejected"]
+                    if st["n_other"]:
+                        t["n_other_outcomes"] = st["n_other"]
+                    if st["tpot"]:
+                        t["tpot_p50_s"] = _pct(st["tpot"], 50)
+                        t["tpot_p99_s"] = _pct(st["tpot"], 99)
+                    if st["queue_delay"]:
+                        t["queue_delay_p50_s"] = _pct(st["queue_delay"], 50)
+                        t["queue_delay_p99_s"] = _pct(st["queue_delay"], 99)
+                    tenants[tid] = t
+                out["tenants"] = tenants
         for name, xs in [("ttft", self.ttft), ("tpot", self.tpot),
                          ("queue_delay", self.queue_delay)]:
             if xs:

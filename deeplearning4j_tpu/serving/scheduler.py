@@ -1,14 +1,23 @@
 """Request queue for the serving engine.
 
-FIFO within priority classes, strict priority across classes (class 0
-drains before class 1, etc. — the simple strict policy; weighted-fair
-would go here if starvation ever matters). Admission control happens at
-``submit`` time, not dequeue time, so a caller holding a rejected
-request knows immediately:
+Strict priority across classes (class 0 drains before class 1, etc.),
+and — when a :class:`~deeplearning4j_tpu.serving.tenancy.TenantRegistry`
+is attached — DEFICIT ROUND-ROBIN across tenants *within* each class,
+weighted by tenant weight, so one flooding tenant cannot starve its
+classmates: each tenant banks ``quantum * weight`` tokens of service
+credit per scheduling visit and its head request is served once the
+credit covers the request's token cost (prompt + max_new). Without a
+registry every request lands in one implicit tenant and the scheduler
+degenerates to the exact FIFO-within-class behavior it always had.
+
+Admission control happens at ``submit`` time, not dequeue time, so a
+caller holding a rejected request knows immediately:
 
 - ``Backpressure`` when the queue is at ``max_queue_depth`` — the HTTP
   front end maps this to 429 so load sheds at the edge instead of
   growing an unbounded in-process queue;
+- ``QuotaExceeded`` (a ``Backpressure``) when the tenant's token-rate
+  bucket is dry — same 429, tagged per tenant in the metrics;
 - ``AdmissionError`` when the request's token budget
   (``len(prompt) + max_new``) cannot fit the engine's cache slots at
   all — queueing it would deadlock the admission loop, since no slot
@@ -23,6 +32,8 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
+import math
+import queue as queue_mod
 import threading
 import time
 from collections import deque
@@ -72,6 +83,13 @@ class Request:
     admission and at every step boundary and retires the request as
     EXPIRED (slot freed) the moment it elapses. ``cancel()`` may be
     called from any thread; the engine honors it within one step.
+
+    Multi-tenant fields: ``tenant_id`` keys the scheduler's
+    weighted-fair tier and the per-tenant metrics ("" = untenanted);
+    ``adapter`` selects the LoRA bank row the slot decodes with (0 =
+    base model). ``stream`` (optional ``queue.Queue``) receives each
+    generated token as it arrives host-side, then ``None`` as the
+    end-of-stream sentinel — the SSE front end drains it.
     """
 
     prompt: np.ndarray
@@ -79,6 +97,9 @@ class Request:
     priority: int = 1
     eos_token: int | None = None
     deadline_s: float | None = None
+    tenant_id: str = ""
+    adapter: int = 0
+    stream: queue_mod.Queue | None = None
     id: str = dataclasses.field(default_factory=_next_id)
     arrival_time: float | None = None
     status: RequestStatus = RequestStatus.QUEUED
@@ -91,6 +112,8 @@ class Request:
         compare=False,
     )
 
+    kind = "generate"
+
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         if self.max_new < 1:
@@ -99,6 +122,16 @@ class Request:
             raise AdmissionError(
                 f"deadline_s must be >= 0, got {self.deadline_s}"
             )
+        if self.adapter < 0:
+            raise AdmissionError(
+                f"adapter must be >= 0, got {self.adapter}"
+            )
+
+    def token_cost(self) -> int:
+        """Service cost in tokens — the unit the DRR tier and the
+        tenant token buckets meter (the same prompt+max_new budget the
+        per-slot admission check uses)."""
+        return len(self.prompt) + self.max_new
 
     def cancel(self) -> None:
         """Request best-effort cancellation (thread-safe, idempotent).
@@ -119,8 +152,45 @@ class Request:
         return (now - self.arrival_time) > self.deadline_s
 
 
+def _empty_prompt() -> np.ndarray:
+    return np.zeros(0, np.int32)
+
+
+@dataclasses.dataclass
+class EmbeddingRequest(Request):
+    """An embeddings lookup riding the SAME queue as generation.
+
+    Served host-side by the engine's admission loop from a zoo
+    embedding model (word2vec/glove) — no KV slot, no device dispatch —
+    but it flows through the scheduler (priority, DRR, quota,
+    backpressure), the per-tenant metrics, and drain exactly like a
+    generation request, which is the point: the serving stack is
+    model-agnostic, not transformer-shaped. ``result`` is filled with
+    ``{word: vector-or-None}`` before ``done`` is set."""
+
+    prompt: np.ndarray = dataclasses.field(default_factory=_empty_prompt)
+    max_new: int = 1
+    model: str = "word2vec"
+    words: tuple[str, ...] = ()
+    result: dict | None = None
+
+    kind = "embedding"
+
+    def __post_init__(self):
+        super().__post_init__()
+        self.words = tuple(str(w) for w in self.words)
+        if not self.words:
+            raise AdmissionError("embedding request needs >= 1 word")
+
+    def token_cost(self) -> int:
+        return len(self.words)
+
+
 class RequestScheduler:
-    """Bounded multi-priority FIFO with admission control."""
+    """Bounded multi-priority queue: strict priority across classes,
+    weighted deficit-round-robin across tenants within a class, FIFO
+    within a tenant. With no ``tenancy`` registry attached the whole
+    thing degenerates to strict-priority FIFO (one implicit tenant)."""
 
     def __init__(
         self,
@@ -128,6 +198,8 @@ class RequestScheduler:
         max_total_tokens: int | None = None,
         n_priorities: int = 3,
         prefix_affinity_tokens: int = 0,
+        tenancy=None,
+        drr_quantum: int = 64,
     ):
         self.max_queue_depth = max_queue_depth
         self.max_total_tokens = max_total_tokens
@@ -136,17 +208,47 @@ class RequestScheduler:
         # tokens match the caller's hint (the previously admitted
         # prompt), so same-prefix requests land in the same admission
         # batch and the prefix cache gets back-to-back hits. Promotion
-        # stays within one priority class — strict priority still wins.
+        # stays within one priority class — strict priority still wins
+        # — and the promoted request's token cost is charged to its
+        # tenant's deficit, so affinity cannot become a fairness leak.
         self.prefix_affinity_tokens = prefix_affinity_tokens
         self.n_priorities = n_priorities
+        self.tenancy = tenancy
+        if drr_quantum < 1:
+            raise ValueError(f"drr_quantum must be >= 1, got {drr_quantum}")
+        self.drr_quantum = drr_quantum
         self._lock = wrap_lock(threading.Lock(), "scheduler._lock")
         # submit() runs on HTTP handler threads while pop()/requeue()
         # run on the engine thread, so the queues only move under the
-        # lock
-        self._queues = [deque() for _ in range(n_priorities)]  # guarded-by: _lock
+        # lock. Per class: tenant_id -> deque (FIFO within tenant),
+        # plus the DRR rotation state (tenant order, rotation index,
+        # banked deficits). Deficits reset when a tenant's queue
+        # empties — idle tenants cannot bank credit (standard DRR).
+        # ``fresh`` marks whether the tenant at ``idx`` is owed its
+        # per-visit quantum: a serving tenant keeps idx with fresh
+        # False and spends banked deficit across pops (DRR's serve-
+        # while-deficit-lasts); new credit only flows when the
+        # rotation actually visits.
+        self._queues = [
+            {} for _ in range(n_priorities)
+        ]  # guarded-by: _lock
+        self._drr = [
+            {"order": [], "idx": 0, "deficit": {}, "fresh": True}
+            for _ in range(n_priorities)
+        ]  # guarded-by: _lock
+
+    def _weight(self, tenant_id: str) -> float:
+        if self.tenancy is not None:
+            t = self.tenancy.get(tenant_id)
+            if t is not None:
+                return t.weight
+        return 1.0
 
     def _depth_unlocked(self) -> int:  # lint: holds _lock
-        return sum(len(q) for q in self._queues)
+        return sum(
+            len(q) for per_class in self._queues
+            for q in per_class.values()
+        )
 
     def __len__(self) -> int:
         with self._lock:
@@ -156,11 +258,68 @@ class RequestScheduler:
     def depth(self) -> int:
         return len(self)
 
+    def has_kind(self, kind: str) -> bool:
+        """Any queued request of ``kind``? The engine's admission entry
+        check: embedding requests stay admissible with zero free KV
+        slots, so a full pool must not skip the admission loop while
+        slotless work waits. O(depth), called only on the full-pool
+        path."""
+        with self._lock:
+            return any(
+                req.kind == kind
+                for per_class in self._queues
+                for q in per_class.values()
+                for req in q
+            )
+
+    def _enqueue_unlocked(self, req: Request, front: bool) -> None:  # lint: holds _lock
+        per_class = self._queues[req.priority]
+        drr = self._drr[req.priority]
+        tid = req.tenant_id
+        q = per_class.get(tid)
+        if q is None:
+            q = per_class[tid] = deque()
+            drr["deficit"].setdefault(tid, 0.0)
+            if not drr["order"]:
+                drr["fresh"] = True  # class was idle: restart rotation
+            if front:
+                # requeue of the only in-flight request of its tenant:
+                # re-enter the rotation at the CURRENT position so the
+                # recovered request is next, as the old FIFO guaranteed
+                drr["order"].insert(drr["idx"], tid)
+            else:
+                drr["order"].append(tid)
+        if front:
+            q.appendleft(req)
+        else:
+            q.append(req)
+
+    def _remove_tenant_if_empty(self, ci: int, tid: str) -> None:  # lint: holds _lock
+        per_class = self._queues[ci]
+        if per_class.get(tid):
+            return
+        per_class.pop(tid, None)
+        drr = self._drr[ci]
+        if tid in drr["order"]:
+            pos = drr["order"].index(tid)
+            was_current = pos == drr["idx"]
+            drr["order"].remove(tid)
+            if pos < drr["idx"]:
+                drr["idx"] -= 1
+            if drr["idx"] >= len(drr["order"]):
+                drr["idx"] = 0  # wrap: rotation restarts at the front
+            if was_current:
+                # whoever now sits at idx is a NEW current tenant and
+                # is owed its visit quantum
+                drr["fresh"] = True
+        drr["deficit"].pop(tid, None)
+
     def submit(self, req: Request) -> str:
         """Enqueue ``req``; returns its id. Raises ``Backpressure`` /
-        ``AdmissionError`` (see module docstring)."""
+        ``QuotaExceeded`` / ``AdmissionError`` (see module docstring)."""
         total = len(req.prompt) + req.max_new
-        if self.max_total_tokens is not None and total > self.max_total_tokens:
+        if (req.kind == "generate" and self.max_total_tokens is not None
+                and total > self.max_total_tokens):
             raise AdmissionError(
                 f"request {req.id}: prompt+max_new ({total}) exceeds the "
                 f"per-slot token budget ({self.max_total_tokens})"
@@ -175,30 +334,42 @@ class RequestScheduler:
                 raise Backpressure(
                     f"queue at max depth ({self.max_queue_depth})"
                 )
+            if self.tenancy is not None:
+                # charge AFTER the depth check (a shed request must not
+                # burn quota) and INSIDE the lock (depth + charge are
+                # one admission decision). Lock order is always
+                # scheduler._lock -> tenancy._lock.
+                self.tenancy.charge(req.tenant_id, req.token_cost())
             req.arrival_time = time.perf_counter()
             req.status = RequestStatus.QUEUED
-            self._queues[req.priority].append(req)
+            self._enqueue_unlocked(req, front=False)
         return req.id
 
     def requeue(self, req: Request) -> None:
         """Put a popped-but-not-admitted request back at the FRONT of
-        its priority class (crash recovery: a request must never be
-        dropped between pop and admission). Bypasses depth/budget
-        checks — the request was already admitted once."""
+        its tenant's queue (crash recovery: a request must never be
+        dropped between pop and admission). Bypasses depth/budget/quota
+        checks — the request was already admitted once — and refunds
+        the token cost its pop charged to the tenant's deficit."""
         with self._lock:
             note_access("scheduler.queues", write=True)
             req.status = RequestStatus.QUEUED
-            self._queues[req.priority].appendleft(req)
+            self._enqueue_unlocked(req, front=True)
+            drr = self._drr[req.priority]
+            drr["deficit"][req.tenant_id] = (
+                drr["deficit"].get(req.tenant_id, 0.0) + req.token_cost()
+            )
 
     def cancel(self, req_id: str) -> bool:
         """Flag a still-queued request as cancelled (it is discarded at
         its admission turn). Returns False when the id is not queued."""
         with self._lock:
-            for q in self._queues:
-                for req in q:
-                    if req.id == req_id:
-                        req.cancel()
-                        return True
+            for per_class in self._queues:
+                for q in per_class.values():
+                    for req in q:
+                        if req.id == req_id:
+                            req.cancel()
+                            return True
         return False
 
     def cancel_all(self) -> int:
@@ -208,39 +379,137 @@ class RequestScheduler:
         Returns the number newly flagged."""
         n = 0
         with self._lock:
-            for q in self._queues:
-                for req in q:
-                    if not req.cancelled:
-                        req.cancel()
-                        n += 1
+            for per_class in self._queues:
+                for q in per_class.values():
+                    for req in q:
+                        if not req.cancelled:
+                            req.cancel()
+                            n += 1
         return n
 
-    def pop(self, affinity_hint: np.ndarray | None = None
-            ) -> Request | None:
-        """Highest-priority, oldest request — or None when idle.
+    def _affinity_pop_unlocked(self, ci, key, admissible):  # lint: holds _lock
+        """Oldest admissible request in class ``ci`` whose first k
+        prompt tokens match ``key`` — across ALL tenant queues, charged
+        to its tenant's deficit (which may go negative: the tenant pays
+        the promotion back in later rotations)."""
+        k = len(key)
+        best = None
+        for tid, q in self._queues[ci].items():
+            for i, req in enumerate(q):
+                if (len(req.prompt) >= k
+                        and tuple(int(t) for t in req.prompt[:k]) == key
+                        and (admissible is None or admissible(req))
+                        and (best is None
+                             or req.arrival_time < best[0].arrival_time)):
+                    best = (req, tid, i)
+        if best is None:
+            return None
+        req, tid, i = best
+        del self._queues[ci][tid][i]
+        drr = self._drr[ci]
+        drr["deficit"][tid] = (
+            drr["deficit"].get(tid, 0.0) - req.token_cost()
+        )
+        self._remove_tenant_if_empty(ci, tid)
+        return req
 
-        With ``prefix_affinity_tokens`` > 0 and an ``affinity_hint``
-        (the prompt just admitted), the front non-empty class is
-        scanned for the OLDEST request sharing the hint's first k
-        tokens and that one is promoted; otherwise plain FIFO. The scan
-        is bounded by the queue depth cap, and affinity never crosses a
-        priority boundary, so strict priority and within-class fairness
-        for non-matching requests are preserved (a matching request
-        only ever moves EARLIER)."""
+    def _serve_head_unlocked(self, ci, tid):  # lint: holds _lock
+        drr = self._drr[ci]
+        req = self._queues[ci][tid].popleft()
+        drr["deficit"][tid] -= req.token_cost()
+        self._remove_tenant_if_empty(ci, tid)
+        return req
+
+    def _drr_pop_unlocked(self, ci, admissible):  # lint: holds _lock
+        """Deficit-round-robin pop from class ``ci``: the rotation
+        banks ``quantum * weight`` credit per VISIT; a tenant's head is
+        served once its credit covers the head's token cost, and the
+        serving tenant stays current across pops (spending its banked
+        deficit) until the credit runs dry — textbook DRR, so long-run
+        service within a class is proportional to tenant weight.
+        Tenants whose head fails ``admissible`` (e.g. at their slot
+        cap) are passed over, keeping their credit. When a whole
+        rotation of fresh quanta serves nobody, the shortfall is banked
+        in closed form (everyone gains the same number of rounds) so a
+        huge head cost cannot spin the lock."""
+        per_class = self._queues[ci]
+        drr = self._drr[ci]
+        order = drr["order"]
+        if not order:
+            return None
+        for _rotation in range(2):
+            any_admissible = False
+            # n + 1 visits: the current tenant's first visit may be
+            # stale (fresh False — quantum already granted), so one
+            # full fresh rotation needs an extra step
+            for _ in range(len(order) + 1):
+                n = len(order)
+                tid = order[drr["idx"]]
+                if drr["fresh"]:
+                    drr["deficit"][tid] = (
+                        drr["deficit"].get(tid, 0.0)
+                        + self.drr_quantum * self._weight(tid)
+                    )
+                    drr["fresh"] = False
+                req = per_class[tid][0]
+                if admissible is None or admissible(req):
+                    any_admissible = True
+                    if drr["deficit"][tid] >= req.token_cost():
+                        return self._serve_head_unlocked(ci, tid)
+                drr["idx"] = (drr["idx"] + 1) % n
+                drr["fresh"] = True
+            if not any_admissible:
+                return None
+            # a full rotation of quanta served nobody: bank the rounds
+            # the closest tenant still needs, for EVERYONE (preserving
+            # the weight ratios), then the next rotation must serve
+            boost = None
+            for tid in order:
+                req = per_class[tid][0]
+                if admissible is not None and not admissible(req):
+                    continue
+                need = req.token_cost() - drr["deficit"].get(tid, 0.0)
+                inc = self.drr_quantum * self._weight(tid)
+                rounds = max(0, math.ceil(need / inc) - 1)
+                if boost is None or rounds < boost:
+                    boost = rounds
+            if boost:
+                for tid in order:
+                    drr["deficit"][tid] = (
+                        drr["deficit"].get(tid, 0.0)
+                        + boost * self.drr_quantum * self._weight(tid)
+                    )
+        return None  # unreachable: rotation 2 always serves
+
+    def pop(self, affinity_hint: np.ndarray | None = None,
+            admissible=None) -> Request | None:
+        """Next request — or None when idle (or when nothing passes
+        ``admissible``, a predicate the engine uses to skip tenants at
+        their concurrent-slot cap without dequeuing their requests).
+
+        Class selection is strict priority. Within the front non-empty
+        class: with ``prefix_affinity_tokens`` > 0 and an
+        ``affinity_hint`` (the prompt just admitted), the OLDEST
+        request sharing the hint's first k tokens is promoted (its cost
+        charged to its tenant's deficit); otherwise the weighted-DRR
+        tenant rotation picks. A class where every request is blocked
+        by ``admissible`` falls through to the next class — a
+        slot-capped high-priority tenant must not idle the engine."""
         k = self.prefix_affinity_tokens
         with self._lock:
             note_access("scheduler.queues", write=True)
-            for q in self._queues:
-                if not q:
+            for ci in range(self.n_priorities):
+                if not any(self._queues[ci].values()):
                     continue
                 if (k > 0 and affinity_hint is not None
                         and len(affinity_hint) >= k):
                     key = tuple(int(t) for t in affinity_hint[:k])
-                    for i, req in enumerate(q):
-                        if (len(req.prompt) >= k
-                                and tuple(int(t) for t in req.prompt[:k])
-                                == key):
-                            del q[i]
-                            return req
-                return q.popleft()
+                    req = self._affinity_pop_unlocked(
+                        ci, key, admissible
+                    )
+                    if req is not None:
+                        return req
+                req = self._drr_pop_unlocked(ci, admissible)
+                if req is not None:
+                    return req
         return None

@@ -3,8 +3,19 @@
 Thin by design: the stdlib ``ThreadingHTTPServer`` + the shared
 ``utils.httpjson`` framing, one background thread running the engine
 loop. Handler threads block on the request's ``done`` event and return
-the finished stream — a synchronous completion API (no streaming; SSE
-would layer on the same engine callbacks).
+the finished stream — a synchronous completion API — or, with
+``"stream": true``, hold the connection open and relay tokens as SSE
+frames straight off the engine's async readback (tokens are already
+host-side per horizon; streaming adds zero device syncs).
+
+Multi-tenant mode: when the engine carries a
+:class:`~deeplearning4j_tpu.serving.tenancy.TenantRegistry`, every
+POST resolves its API key (``X-API-Key`` header, or ``Authorization:
+Bearer <key>``) to a tenant — unknown keys get 401, a missing key maps
+to the registry's anonymous tenant if one exists. The tenant supplies
+scheduling priority, the default LoRA adapter, and the token-rate
+quota whose exhaustion surfaces as 429 (``QuotaExceeded`` subclasses
+``Backpressure``, so the shed-load path is shared).
 
 The engine thread is SUPERVISED: an exception escaping
 ``engine.step()`` (an ``EngineCrash`` from the fault layer, or any
@@ -19,12 +30,25 @@ Endpoints:
 
 - ``POST /v1/generate`` — body ``{"prompt": [ints] | "text",
   "max_new": int, "priority"?: int, "eos_token"?: int,
-  "deadline_s"?: float}``; returns ``{"id", "tokens", "text"?}``.
-  429 on queue backpressure, 400 on a request that can never fit a
-  slot, 503 while draining/stopped, 408 when ``deadline_s`` expired,
-  500 when the request was failed by the fault layer, 504 on handler
-  timeout (the request IS cancelled in the engine — its KV slot frees
-  within one step, it does not keep decoding for a gone client).
+  "deadline_s"?: float, "adapter"?: int, "stream"?: bool}``; returns
+  ``{"id", "tokens", "text"?}``. 429 on queue backpressure or tenant
+  quota, 400 on a request that can never fit a slot (or an adapter
+  index outside the loaded LoRA bank), 401 on an unknown API key, 503
+  while draining/stopped, 408 when ``deadline_s`` expired, 500 when
+  the request was failed by the fault layer, 504 on handler timeout
+  (the request IS cancelled in the engine — its KV slot frees within
+  one step, it does not keep decoding for a gone client). With
+  ``"stream": true`` the response is ``text/event-stream``: one
+  ``data: {"token": t}`` frame per generated token, then a final
+  ``data: {"done": true, ...}`` frame carrying the terminal status;
+  the concatenated streamed tokens are byte-identical to the
+  non-streaming ``tokens`` tail, and a client disconnect mid-stream
+  cancels the request in the engine.
+- ``POST /v1/embeddings`` — body ``{"words": ["w", ...],
+  "model"?: "word2vec"|"glove"}``; returns ``{"id", "model",
+  "vectors": {word: [floats] | null}}`` (null = out-of-vocabulary).
+  Embedding lookups ride the same scheduler/quota/metrics/drain
+  machinery as generation but are served host-side without a KV slot.
 - ``GET /metrics`` — Prometheus text exposition (version 0.0.4) of the
   engine's metrics registry: request outcomes, retries, restarts,
   backpressure, queue depth, KV occupancy/churn, TTFT/TPOT and
@@ -65,7 +89,9 @@ Text prompts/completions use the repo's byte-level convention
 
 from __future__ import annotations
 
+import json
 import logging
+import queue
 import threading
 import time
 from http.server import ThreadingHTTPServer
@@ -76,6 +102,7 @@ from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.scheduler import (
     AdmissionError,
     Backpressure,
+    EmbeddingRequest,
     Request,
     RequestStatus,
 )
@@ -97,6 +124,10 @@ _STATUS_HTTP = {
     RequestStatus.EXPIRED: 408,
     RequestStatus.CANCELLED: 499,  # nginx-style: client gone
 }
+
+#: sentinel from ``_resolve_tenant`` for an API key the registry does
+#: not know (distinct from None = server running without tenancy)
+_UNKNOWN_KEY = object()
 
 
 class ServingServer:
@@ -140,7 +171,7 @@ class ServingServer:
                 if path == "/profile":
                     server._handle_profile(self)
                     return
-                if path != "/v1/generate":
+                if path not in ("/v1/generate", "/v1/embeddings"):
                     send_json(self, 404, {"error": "not found"})
                     return
                 if server._draining.is_set() or server._stop.is_set():
@@ -152,52 +183,18 @@ class ServingServer:
                         "last_error": server._last_error,
                     })
                     return
+                tenant = server._resolve_tenant(self)
+                if tenant is _UNKNOWN_KEY:
+                    send_json(self, 401, {"error": "unknown API key"})
+                    return
                 body = read_json_body(self)
                 if body is None:
                     send_json(self, 400, {"error": "malformed JSON"})
                     return
-                try:
-                    req = server._parse_request(body)
-                except (AdmissionError, ValueError, TypeError) as e:
-                    send_json(self, 400, {"error": str(e)})
-                    return
-                try:
-                    server.engine.submit(req)
-                except Backpressure as e:
-                    send_json(self, 429, {"error": str(e)})
-                    return
-                except AdmissionError as e:
-                    send_json(self, 400, {"error": str(e)})
-                    return
-                if not req.done.wait(server.request_timeout_s):
-                    # cancel in the engine so the slot stops decoding
-                    # for a client that is about to get a timeout
-                    req.cancel()
-                    log_event(_log, "request_completed", req_id=req.id,
-                              http=504, status="timeout")
-                    send_json(self, 504, {"error": "generation timed out"})
-                    return
-                if req.status is not RequestStatus.FINISHED:
-                    code = _STATUS_HTTP.get(req.status, 500)
-                    server.engine.pop_result(req.id)  # drop partial stream
-                    log_event(_log, "request_completed", req_id=req.id,
-                              http=code, status=req.status.value)
-                    send_json(self, code, {
-                        "id": req.id,
-                        "status": req.status.value,
-                        "error": req.error or req.status.value,
-                    })
-                    return
-                toks = server.engine.pop_result(req.id).tolist()
-                log_event(_log, "request_completed", req_id=req.id,
-                          http=200, status="finished",
-                          n_tokens=len(toks) - len(req.prompt))
-                out = {"id": req.id, "tokens": toks}
-                if server._byte_vocab():
-                    out["text"] = bytes(
-                        t % 256 for t in toks
-                    ).decode("latin-1")
-                send_json(self, 200, out)
+                if path == "/v1/embeddings":
+                    server._handle_embeddings(self, body, tenant)
+                else:
+                    server._handle_generate(self, body, tenant)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         # named threads: sanitizer reports (and py-spy dumps)
@@ -294,7 +291,24 @@ class ServingServer:
     def _byte_vocab(self) -> bool:
         return self.engine.cfg.vocab_size <= 256
 
-    def _parse_request(self, body: dict) -> Request:
+    def _resolve_tenant(self, handler):
+        """TenantConfig for the request's API key (``X-API-Key``
+        header, or ``Authorization: Bearer <key>``). None when the
+        server runs without tenancy; the ``_UNKNOWN_KEY`` sentinel for
+        a key the registry does not know (the caller answers 401 —
+        which an anonymous-less registry also gives keyless requests)."""
+        tenancy = self.engine.tenancy
+        if tenancy is None:
+            return None
+        key = handler.headers.get("X-API-Key")
+        if not key:
+            auth = handler.headers.get("Authorization", "")
+            if auth.startswith("Bearer "):
+                key = auth[len("Bearer "):]
+        t = tenancy.resolve_key(key)
+        return _UNKNOWN_KEY if t is None else t
+
+    def _parse_request(self, body: dict, tenant=None) -> Request:
         prompt = body.get("prompt")
         if isinstance(prompt, str):
             if not self._byte_vocab():
@@ -304,18 +318,192 @@ class ServingServer:
             prompt = list(prompt.encode("latin-1", errors="replace"))
         if not isinstance(prompt, list):
             raise ValueError("'prompt' must be a token list or a string")
+        # the tenant supplies scheduling priority and the LoRA adapter
+        # unless the body names its own
         return Request(
             prompt=prompt,
             max_new=int(body.get("max_new", 16)),
-            priority=int(body.get("priority", 1)),
+            priority=int(body.get(
+                "priority", tenant.priority if tenant is not None else 1
+            )),
             eos_token=(
                 int(body["eos_token"]) if "eos_token" in body else None
             ),
             deadline_s=(
                 float(body["deadline_s"]) if "deadline_s" in body else None
             ),
+            adapter=int(body.get(
+                "adapter",
+                tenant.default_adapter if tenant is not None else 0,
+            )),
+            tenant_id=tenant.tenant_id if tenant is not None else "",
+            stream=queue.Queue() if body.get("stream") else None,
             done=threading.Event(),
         )
+
+    def _handle_generate(self, handler, body: dict, tenant) -> None:
+        try:
+            req = self._parse_request(body, tenant)
+        except (AdmissionError, ValueError, TypeError) as e:
+            send_json(handler, 400, {"error": str(e)})
+            return
+        try:
+            self.engine.submit(req)
+        except Backpressure as e:
+            send_json(handler, 429, {"error": str(e)})
+            return
+        except AdmissionError as e:
+            send_json(handler, 400, {"error": str(e)})
+            return
+        if req.stream is not None:
+            self._stream_generate(handler, req)
+            return
+        if not req.done.wait(self.request_timeout_s):
+            # cancel in the engine so the slot stops decoding
+            # for a client that is about to get a timeout
+            req.cancel()
+            log_event(_log, "request_completed", req_id=req.id,
+                      http=504, status="timeout")
+            send_json(handler, 504, {"error": "generation timed out"})
+            return
+        if req.status is not RequestStatus.FINISHED:
+            code = _STATUS_HTTP.get(req.status, 500)
+            self.engine.pop_result(req.id)  # drop partial stream
+            log_event(_log, "request_completed", req_id=req.id,
+                      http=code, status=req.status.value)
+            send_json(handler, code, {
+                "id": req.id,
+                "status": req.status.value,
+                "error": req.error or req.status.value,
+            })
+            return
+        toks = self.engine.pop_result(req.id).tolist()
+        log_event(_log, "request_completed", req_id=req.id,
+                  http=200, status="finished",
+                  n_tokens=len(toks) - len(req.prompt))
+        out = {"id": req.id, "tokens": toks}
+        if self._byte_vocab():
+            out["text"] = bytes(
+                t % 256 for t in toks
+            ).decode("latin-1")
+        send_json(handler, 200, out)
+
+    @staticmethod
+    def _sse(handler, payload: dict) -> None:
+        """One SSE ``data:`` frame, flushed (per-token latency is the
+        point of streaming)."""
+        handler.wfile.write(b"data: " + json.dumps(payload).encode()
+                            + b"\n\n")
+        handler.wfile.flush()
+
+    def _stream_generate(self, handler, req: Request) -> None:
+        """SSE relay: one frame per generated token as each horizon's
+        readback lands on ``req.stream``, then a final frame with the
+        terminal status. The engine sets the terminal status BEFORE
+        putting the end-of-stream sentinel, so reading the sentinel
+        here orders correctly with ``req.status``. A client disconnect
+        mid-stream cancels the request in the engine (its KV slot
+        frees within one horizon — no decoding for a gone client)."""
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        deadline = time.monotonic() + self.request_timeout_s
+        byte_vocab = self._byte_vocab()
+        n = 0
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    req.cancel()
+                    log_event(_log, "request_completed", req_id=req.id,
+                              http=504, status="timeout", stream=True)
+                    self._sse(handler, {"error": "generation timed out",
+                                        "done": True})
+                    return
+                try:
+                    tok = req.stream.get(timeout=min(remaining, 1.0))
+                except queue.Empty:
+                    continue  # still decoding; re-check the deadline
+                if tok is None:
+                    break  # end-of-stream sentinel
+                n += 1
+                frame = {"token": int(tok)}
+                if byte_vocab:
+                    frame["text"] = chr(tok % 256)
+                self._sse(handler, frame)
+            final = {"id": req.id, "status": req.status.value,
+                     "n_tokens": n, "done": True}
+            if req.status is not RequestStatus.FINISHED and req.error:
+                final["error"] = req.error
+            self._sse(handler, final)
+            log_event(_log, "request_completed", req_id=req.id, http=200,
+                      status=req.status.value, n_tokens=n, stream=True)
+        except (BrokenPipeError, ConnectionResetError):
+            req.cancel()
+            log_event(_log, "request_completed", req_id=req.id, http=499,
+                      status="client_gone", n_tokens=n, stream=True)
+        finally:
+            # the stream already delivered the tokens; drop the stored
+            # copy so streaming traffic doesn't grow the results dict
+            self.engine.pop_result(req.id)
+
+    def _handle_embeddings(self, handler, body: dict, tenant) -> None:
+        words = body.get("words")
+        if isinstance(words, str):
+            words = words.split()
+        if (not isinstance(words, list) or not words
+                or not all(isinstance(w, str) for w in words)):
+            send_json(handler, 400, {
+                "error": "'words' must be a non-empty list of strings",
+            })
+            return
+        if not self.engine.embedders:
+            send_json(handler, 503, {"error": "no embedding models loaded"})
+            return
+        req = EmbeddingRequest(
+            words=tuple(words),
+            model=str(body.get("model", "word2vec")),
+            priority=int(body.get(
+                "priority", tenant.priority if tenant is not None else 1
+            )),
+            tenant_id=tenant.tenant_id if tenant is not None else "",
+            done=threading.Event(),
+        )
+        try:
+            self.engine.submit(req)
+        except Backpressure as e:
+            send_json(handler, 429, {"error": str(e)})
+            return
+        except AdmissionError as e:
+            send_json(handler, 400, {"error": str(e)})
+            return
+        if not req.done.wait(self.request_timeout_s):
+            req.cancel()
+            log_event(_log, "request_completed", req_id=req.id,
+                      http=504, status="timeout", kind="embedding")
+            send_json(handler, 504, {"error": "embedding timed out"})
+            return
+        if req.status is not RequestStatus.FINISHED:
+            code = _STATUS_HTTP.get(req.status, 500)
+            log_event(_log, "request_completed", req_id=req.id,
+                      http=code, status=req.status.value, kind="embedding")
+            send_json(handler, code, {
+                "id": req.id,
+                "status": req.status.value,
+                "error": req.error or req.status.value,
+            })
+            return
+        vectors = {
+            w: (None if v is None else [float(x) for x in v])
+            for w, v in req.result.items()
+        }
+        log_event(_log, "request_completed", req_id=req.id, http=200,
+                  status="finished", kind="embedding", n_words=len(words))
+        send_json(handler, 200, {
+            "id": req.id, "model": req.model, "vectors": vectors,
+        })
 
     def _hung(self, now: float | None = None) -> tuple[bool, float | None]:
         """(hung?, beat_age_s). Hung = the loop thread is alive but its
@@ -367,6 +555,16 @@ class ServingServer:
         )
         if eng.prefix_cache is not None:
             out["prefix_cache"] = eng.prefix_cache.stats()
+        if eng.tenancy is not None:
+            buckets = {}
+            for tid in eng.tenancy.tenant_ids():
+                lvl = eng.tenancy.bucket_level(tid)
+                if lvl is not None:
+                    buckets[tid] = round(lvl, 1)
+            out["tenancy"] = {
+                "n_tenants": len(eng.tenancy),
+                "bucket_levels": buckets,
+            }
         return out
 
     def _engine_loop(self) -> None:
