@@ -1,3 +1,18 @@
-from deeplearning4j_tpu.cli import main
+import os
+import sys
+
+if sys.argv[1:2] == ["audit"]:
+    # the audit's TP=2 surface needs >= 2 visible devices; on a
+    # CPU-only host XLA can fake them, but only if the flag lands
+    # before jax initializes — and importing the package (below)
+    # already imports jax, so this must happen here, not in cli.py
+    # (same bootstrap as tests/conftest.py)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+from deeplearning4j_tpu.cli import main  # noqa: E402
 
 raise SystemExit(main())

@@ -1,0 +1,481 @@
+"""Program-surface registry: every compiled family the serving engine
+can emit, as abstract avals — no devices, no weights, no execution.
+
+The engine's jit caches call the module-level ``build_*_program``
+factories in ``serving.engine`` with its own closures; this module
+calls the SAME factories with closures built from a
+:class:`TransformerConfig` plus a :class:`ServingGeometry`, and derives
+every argument as a :class:`jax.ShapeDtypeStruct` via ``eval_shape``.
+A registry entry is therefore the live program by construction — the
+static auditor (``analysis.audit``) traces these specs and checks
+dtype promotion, donation, collective signatures, callback smuggling,
+and the compile-surface bounds without ever running the engine.
+
+Family keys mirror the engine's jit-cache keys exactly (step programs
+per horizon, prefill/chunk per pow2 bucket, batched admission per
+(bucket, pow2 group)), so a test can diff the registry against a live
+engine's ``CompileCountGuard`` families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    _chunk_builder,
+    _decode_builder,
+    init_lora_bank,
+    init_transformer,
+    tp_collective_contract,
+)
+from deeplearning4j_tpu.parallel.mesh import model_parallel_mesh
+from deeplearning4j_tpu.serving.engine import (
+    PROGRAM_DONATION,
+    build_batch_hit_program,
+    build_batch_prefill_program,
+    build_chunk_program,
+    build_deact_program,
+    build_hit_insert_program,
+    build_insert_program,
+    build_logit_row_program,
+    build_prefill_program,
+    build_replay_program,
+    build_seg_fetch_program,
+    build_seg_store_program,
+    build_step_program,
+)
+
+
+def _sds(tree):
+    """Aval tree -> ShapeDtypeStruct tree (jittable-argument form)."""
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
+    )
+
+
+def _i32(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def _pow2_up_to(limit: int) -> list[int]:
+    out, b = [], 1
+    while b <= limit:
+        out.append(b)
+        b *= 2
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingGeometry:
+    """The serving-side knobs that determine the compiled surface —
+    the registry analogue of ``ServingEngine.__init__``'s geometry
+    arguments. Defaults give a small surface that traces in seconds
+    on CPU (the CI audit geometry)."""
+
+    n_slots: int = 4
+    max_total: int = 64
+    temperature: float = 0.0
+    top_k: int | None = None
+    approx_top_k: bool = False
+    decode_horizon: int = 2
+    adaptive_horizon: bool = True
+    prefill_max_bucket: int = 32
+    tp: int = 1
+    n_adapters: int = 0
+    lora_rank: int = 4
+    prefix_segments: int = 2
+
+    def tpad(self, cfg: TransformerConfig) -> int:
+        """Pooled slab row count — mirrors ``init_caches``."""
+        total = min(self.max_total, cfg.max_len)
+        if total <= 1024:
+            return -(-total // 8) * 8
+        return -(-total // 512) * 512
+
+    def buckets(self, cfg: TransformerConfig) -> list[int]:
+        """The pow2 prompt-bucket grid — mirrors the engine's
+        ``_min_bucket``/``_max_bucket`` derivation."""
+        limit = min(
+            self.prefill_max_bucket, cfg.max_len, self.tpad(cfg)
+        )
+        mb = 1
+        while mb * 2 <= limit:
+            mb *= 2
+        lo = min(8, mb)
+        return [b for b in _pow2_up_to(mb) if b >= lo]
+
+    def horizons(self) -> list[int]:
+        """Fused-step horizons the engine can key programs on:
+        {K}, or {1, K} under the adaptive horizon."""
+        k = max(1, self.decode_horizon)
+        return sorted({1, k}) if self.adaptive_horizon else [k]
+
+    def group_sizes(self) -> list[int]:
+        """Batched-admission group sizes (pow2, padded up)."""
+        return _pow2_up_to(self.n_slots)
+
+    def to_json_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One enumerable compiled program: a build() thunk returning the
+    (python callable, abstract argument tuple) pair the auditor
+    traces, plus the family's DECLARED contracts — donation argnums
+    (from ``PROGRAM_DONATION``) and the collective signature ({} for
+    single-chip families: any collective is drift)."""
+
+    name: str
+    family: str
+    donate: tuple[int, ...]
+    tp: bool
+    collectives: dict[str, int]
+    build: object  # () -> (fn, args)
+
+    def trace(self):
+        fn, args = self.build()
+        return jax.jit(fn).trace(*args)
+
+
+class _FamilyAvals:
+    """Shared abstract avals for one (cfg, geometry, tp_mesh) tuple —
+    params after the serving weight cast, pooled caches, scratch
+    caches, prefix region, and the per-slot device state."""
+
+    def __init__(self, cfg: TransformerConfig, geom: ServingGeometry,
+                 tp_mesh=None, lora: bool = False):
+        self.cfg, self.geom = cfg, geom
+        fwd1, ic, do_prefill, cast = _decode_builder(
+            cfg, tp_mesh=tp_mesh
+        )
+        self.fwd1 = fwd1
+        self.init_caches = ic
+        self.do_prefill = do_prefill
+        self.fwd_chunk = _chunk_builder(cfg, tp_mesh=tp_mesh)
+
+        def abstract_params():
+            p = init_transformer(jax.random.key(0), cfg)
+            if lora:
+                p = dict(p)
+                p["lora"] = init_lora_bank(
+                    jax.random.key(1), cfg,
+                    n_adapters=max(2, geom.n_adapters),
+                    rank=geom.lora_rank,
+                )
+            return cast(p)
+
+        self.params = _sds(jax.eval_shape(abstract_params))
+        self.caches = _sds(
+            jax.eval_shape(lambda: ic(geom.n_slots, geom.max_total))
+        )
+        self.scratch = _sds(
+            jax.eval_shape(lambda: ic(1, geom.max_total))
+        )
+        self.region = _sds(
+            jax.eval_shape(
+                lambda: ic(geom.prefix_segments, geom.max_total)
+            )
+        )
+        n, v = geom.n_slots, cfg.vocab_size
+        self.logits = jax.ShapeDtypeStruct((n, v), jnp.float32)
+        self.row_logits = jax.ShapeDtypeStruct((1, v), jnp.float32)
+        self.pos = _i32(n)
+        self.active = jax.ShapeDtypeStruct((n,), jnp.bool_)
+        self.budget = _i32(n)
+        self.eos = _i32(n)
+        key_shape = jax.eval_shape(
+            lambda: jax.random.key_data(jax.random.key(0))
+        ).shape
+        self.slot_keys = jax.ShapeDtypeStruct(
+            (n,) + key_shape, jnp.uint32
+        )
+        self.adapters = _i32(n)
+
+    def state(self):
+        return (self.caches, self.logits, self.pos, self.active,
+                self.budget, self.eos)
+
+
+def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
+               tp: bool = False, suffix: str = "",
+               families: set[str] | None = None) -> list[ProgramSpec]:
+    """ProgramSpecs for every family under one aval set. ``families``
+    restricts the emitted set (TP/LoRA variants re-enumerate only the
+    forward-pass families — the copy/slice programs contain no model
+    code, so their sharded variants add tracing time, not coverage)."""
+    cfg = av.cfg
+    out: list[ProgramSpec] = []
+
+    def want(f):
+        return families is None or f in families
+
+    def add(name, family, build, n_substeps=0, scanned=False):
+        contract = (
+            tp_collective_contract(cfg, n_substeps, scanned=scanned)
+            if tp and n_substeps else {}
+        )
+        out.append(ProgramSpec(
+            name=name + suffix, family=family,
+            donate=PROGRAM_DONATION[family], tp=tp,
+            collectives=contract, build=build,
+        ))
+
+    if want("step"):
+        for k in geom.horizons():
+            add(
+                f"step[K={k}]", "step",
+                lambda k=k: (
+                    build_step_program(
+                        av.fwd1, k, geom.temperature, geom.top_k,
+                        geom.approx_top_k,
+                    ),
+                    (av.params, *av.state(), av.slot_keys,
+                     av.adapters),
+                ),
+                n_substeps=k,
+            )
+    if want("replay"):
+        add(
+            "replay", "replay",
+            lambda: (
+                build_replay_program(av.fwd1),
+                (av.params, av.caches, av.logits, _i32(geom.n_slots),
+                 av.pos,
+                 jax.ShapeDtypeStruct((geom.n_slots,), jnp.bool_),
+                 av.adapters),
+            ),
+            n_substeps=1,
+        )
+    if want("deactivate"):
+        add(
+            "deactivate", "deactivate",
+            lambda: (build_deact_program(), (av.active, _i32())),
+        )
+    if want("prefill"):
+        for b in geom.buckets(cfg):
+            add(
+                f"prefill[b={b}]", "prefill",
+                lambda b=b: (
+                    build_prefill_program(
+                        av.do_prefill, av.init_caches, geom.max_total
+                    ),
+                    (*av.state(), av.params, _i32(1, b), _i32(),
+                     _i32(), _i32(), _i32(), _i32(), _i32(1)),
+                ),
+                n_substeps=1, scanned=cfg.scan_layers,
+            )
+    if want("chunk"):
+        for b in geom.buckets(cfg):
+            add(
+                f"chunk[b={b}]", "chunk",
+                lambda b=b: (
+                    build_chunk_program(av.fwd_chunk),
+                    (av.params, av.scratch, _i32(1, b), _i32(),
+                     _i32(), _i32(1)),
+                ),
+                n_substeps=1,
+            )
+    if want("insert"):
+        add(
+            "insert", "insert",
+            lambda: (
+                build_insert_program(),
+                (*av.state(), av.scratch, av.row_logits, _i32(),
+                 _i32(), _i32(), _i32()),
+            ),
+        )
+    if want("hit_insert"):
+        add(
+            "hit_insert", "hit_insert",
+            lambda: (
+                build_hit_insert_program(),
+                (*av.state(), av.region, av.row_logits, _i32(),
+                 _i32(), _i32(), _i32(), _i32()),
+            ),
+        )
+    if want("seg_fetch"):
+        add(
+            "seg_fetch", "seg_fetch",
+            lambda: (build_seg_fetch_program(), (av.region, _i32())),
+        )
+    if want("seg_store"):
+        add(
+            "seg_store", "seg_store",
+            lambda: (
+                build_seg_store_program(),
+                (av.region, av.caches, _i32(), _i32()),
+            ),
+        )
+    if want("logit_row"):
+        add(
+            "logit_row", "logit_row",
+            lambda: (build_logit_row_program(), (av.logits, _i32())),
+        )
+    if want("batch_prefill"):
+        for b in geom.buckets(cfg):
+            for nb in geom.group_sizes():
+                add(
+                    f"batch_prefill[b={b},n={nb}]", "batch_prefill",
+                    lambda b=b, nb=nb: (
+                        build_batch_prefill_program(
+                            av.do_prefill, av.init_caches,
+                            geom.max_total, nb,
+                        ),
+                        (*av.state(), av.params, _i32(nb, b),
+                         _i32(nb), _i32(nb), _i32(nb), _i32(nb),
+                         _i32(nb), _i32(nb)),
+                    ),
+                    n_substeps=1,
+                )
+    if want("batch_hit"):
+        for b in geom.buckets(cfg):
+            for nb in geom.group_sizes():
+                add(
+                    f"batch_hit[b={b},n={nb}]", "batch_hit",
+                    lambda b=b, nb=nb: (
+                        build_batch_hit_program(av.fwd_chunk, nb),
+                        (*av.state(), av.params, av.region, _i32(nb),
+                         _i32(nb, b), _i32(), _i32(nb), _i32(nb),
+                         _i32(nb), _i32(nb), _i32(nb), _i32(nb)),
+                    ),
+                    n_substeps=1,
+                )
+    return out
+
+
+#: forward-pass families — the ones whose TP variants carry the
+#: collective contract (the copy/slice programs contain no model code)
+_FORWARD_FAMILIES = {"step", "replay", "prefill", "chunk"}
+
+
+def enumerate_programs(
+    cfg: TransformerConfig, geom: ServingGeometry
+) -> list[ProgramSpec]:
+    """Every program family the engine can emit under ``(cfg, geom)``:
+    the full single-chip surface, plus TP-sharded variants of the
+    forward families when ``geom.tp > 1`` (requires ``tp`` visible
+    devices — the engine has the same requirement), plus the
+    LoRA-bank fused-step variant when ``geom.n_adapters > 0``."""
+    specs = _specs_for(_FamilyAvals(cfg, geom), geom)
+    if geom.tp > 1:
+        if jax.device_count() < geom.tp:
+            raise ValueError(
+                f"tp={geom.tp} needs >= {geom.tp} devices "
+                f"(have {jax.device_count()})"
+            )
+        # mirrors the engine: the Pallas decode kernel cannot be
+        # GSPMD-partitioned, so TP serving always runs the dense path
+        cfg_tp = dataclasses.replace(cfg, decode_kernel=False)
+        mesh = model_parallel_mesh(geom.tp)
+        specs += _specs_for(
+            _FamilyAvals(cfg_tp, geom, tp_mesh=mesh), geom,
+            tp=True, suffix=f"[tp={geom.tp}]",
+            families=_FORWARD_FAMILIES,
+        )
+    if geom.n_adapters > 0:
+        # the bank rides inside params; the adapter-index vector is
+        # already a traced argument of every step program, so the only
+        # new family is the bank-carrying step itself
+        cfg_lora = dataclasses.replace(cfg, decode_kernel=False)
+        specs += _specs_for(
+            _FamilyAvals(cfg_lora, geom, lora=True), geom,
+            suffix="[lora]", families={"step"},
+        )
+    return specs
+
+
+def expected_surface(
+    cfg: TransformerConfig, geom: ServingGeometry
+) -> dict[str, object]:
+    """The compile-surface contract, in ``CompileCountGuard``'s
+    vocabulary: allowed jit-cache keys per keyed family and the
+    O(log max_len) count bound. The audit's static surface check
+    asserts the registry's enumeration equals this; the live-engine
+    test asserts an engine's observed keys are a subset of it."""
+    buckets = set(geom.buckets(cfg))
+    groups = set(geom.group_sizes())
+    mb = max(buckets)
+    import math
+
+    return {
+        "step": set(geom.horizons()),
+        "prefill": buckets,
+        "chunk": buckets,
+        "batch_prefill": {(b, n) for b in buckets for n in groups},
+        "batch_hit": {(b, n) for b in buckets for n in groups},
+        "singletons": {
+            "replay", "deactivate", "insert", "hit_insert",
+            "seg_fetch", "seg_store", "logit_row",
+        },
+        "log_bound": int(math.log2(mb)) + 1,
+    }
+
+
+def live_engine_families(engine) -> dict[str, set]:
+    """A live engine's OBSERVED jit-cache keys, in
+    :func:`expected_surface` vocabulary — the bridge the registry-vs-
+    engine test diffs: every observed key must be inside the surface
+    the registry enumerates for the same geometry."""
+    singles = set()
+    for name, fn in (
+        ("replay", engine._replay_fn),
+        ("deactivate", engine._deact_fn),
+        ("insert", engine._insert_fn),
+        ("hit_insert", engine._hit_insert_fn),
+        ("seg_fetch", engine._seg_fetch_fn),
+        ("seg_store", engine._seg_store_fn),
+        ("logit_row", engine._logit_row_fn),
+    ):
+        if fn is not None:
+            singles.add(name)
+    return {
+        "step": set(engine._step_fns),
+        "prefill": set(engine._prefill_fns),
+        "chunk": set(engine._chunk_fns),
+        "batch_prefill": set(engine._batch_prefill_fns),
+        "batch_hit": set(engine._batch_hit_fns),
+        "singletons": singles,
+    }
+
+
+def default_audit_config() -> TransformerConfig:
+    """The committed audit geometry's model config: small enough that
+    the full surface traces + compiles in seconds on CPU, bf16 compute
+    so the dtype-promotion lint has teeth, GQA + RoPE so the audited
+    forward is the feature-bearing one. ``decode_kernel=False``:
+    the auditor lowers on CPU, where the Pallas TPU kernel cannot."""
+    return TransformerConfig(
+        vocab_size=128,
+        d_model=64,
+        n_heads=4,
+        n_kv_heads=2,
+        n_layers=2,
+        d_ff=128,
+        max_len=64,
+        rope=True,
+        compute_dtype=jnp.bfloat16,
+        decode_kernel=False,
+    )
+
+
+def default_audit_geometry() -> ServingGeometry:
+    """The committed audit geometry (see ``.graftaudit.json``): every
+    family class is populated — adaptive horizon (two step programs),
+    three buckets, batched groups to 4, TP=2 forward variants, one
+    LoRA step variant."""
+    return ServingGeometry(
+        n_slots=4,
+        max_total=64,
+        decode_horizon=2,
+        adaptive_horizon=True,
+        prefill_max_bucket=32,
+        tp=2,
+        n_adapters=2,
+        lora_rank=4,
+        prefix_segments=2,
+    )

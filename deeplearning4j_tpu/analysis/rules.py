@@ -34,27 +34,96 @@ def check_host_sync(mod: ModuleInfo) -> list[Finding]:
     functions. Only designated sync points (``# lint: sync-ok``) are
     allowed: the engine's pipelined readback budgets ONE blocking sync
     per horizon, and any extra one serializes dispatch against
-    readback."""
-    out: list[Finding] = []
-    for fn, qual in iter_functions(mod.tree):
-        if mod.def_directive(fn, "hot-path") is None:
+    readback.
+
+    INTERPROCEDURAL within the module: a hot-path function calling a
+    helper that syncs (directly, or through further helpers — a
+    fixpoint over the module call graph) is itself a finding at the
+    call site, naming the chain. Resolution covers ``self.helper()``
+    within a class and bare-name calls to module-level functions —
+    the shapes this codebase's hot paths actually use. Hot-path
+    callees are NOT re-flagged through their callers: their own sync
+    sites already produce findings, and annotating one ``sync-ok``
+    must not require annotating every transitive caller too."""
+    funcs = {qual: fn for fn, qual in iter_functions(mod.tree)}
+    hot = {
+        qual for qual, fn in funcs.items()
+        if mod.def_directive(fn, "hot-path") is not None
+    }
+
+    def callee_qual(caller: str, call: ast.Call) -> str | None:
+        f = call.func
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and "." in caller):
+            cand = caller.rsplit(".", 1)[0] + "." + f.attr
+            if cand in funcs:
+                return cand
+        elif isinstance(f, ast.Name) and f.id in funcs:
+            return f.id
+        return None
+
+    # seed: non-hot-path functions with an UNANNOTATED direct sync
+    # (an annotated site is a designated readback — callers inherit
+    # the designation, not the hazard)
+    via: dict[str, str] = {}
+    for qual, fn in funcs.items():
+        if qual in hot:
             continue
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             label = _sync_call_label(node)
-            if label is None:
+            if label and not mod.has_directive(node, "sync-ok"):
+                via[qual] = label
+                break
+
+    # fixpoint: propagate syncing-ness up the call graph
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn in funcs.items():
+            if qual in hot or qual in via:
+                continue
+            for node in ast.walk(fn):
+                if (not isinstance(node, ast.Call)
+                        or mod.has_directive(node, "sync-ok")):
+                    continue
+                cq = callee_qual(qual, node)
+                if cq is not None and cq in via:
+                    via[qual] = f"{cq}: {via[cq]}"
+                    changed = True
+                    break
+
+    out: list[Finding] = []
+    for qual in hot:
+        for node in ast.walk(funcs[qual]):
+            if not isinstance(node, ast.Call):
                 continue
             if mod.has_directive(node, "sync-ok"):
                 continue
-            out.append(mod.finding(
-                "host-sync", node,
-                f"{label} in hot-path function {qual!r} is an implicit "
-                f"device->host sync; annotate the designated readback "
-                f"point with '# lint: sync-ok <reason>' or move the "
-                f"sync off the hot path",
-                qual,
-            ))
+            label = _sync_call_label(node)
+            if label is not None:
+                out.append(mod.finding(
+                    "host-sync", node,
+                    f"{label} in hot-path function {qual!r} is an "
+                    f"implicit device->host sync; annotate the "
+                    f"designated readback point with "
+                    f"'# lint: sync-ok <reason>' or move the sync "
+                    f"off the hot path",
+                    qual,
+                ))
+                continue
+            cq = callee_qual(qual, node)
+            if cq is not None and cq in via:
+                out.append(mod.finding(
+                    "host-sync", node,
+                    f"hot-path function {qual!r} calls {cq!r}, which "
+                    f"syncs ({via[cq]}); annotate the call with "
+                    f"'# lint: sync-ok <reason>' or move the sync "
+                    f"out of {cq!r}",
+                    qual,
+                ))
     return out
 
 

@@ -623,12 +623,16 @@ def cmd_serve(args) -> int:
 def _report_sanitizers(engine, lock_san, sync_san) -> int:
     """Uninstall the serve-mode sanitizers, run the compile-count
     guard, print one summary line per detector, and return 1 when any
-    violation was recorded."""
+    violation was recorded. ``engine`` is None for processes that
+    never compile programs (the router) — the lock/sync detectors
+    still apply, the compile-count guard does not."""
     from deeplearning4j_tpu.analysis.sanitizers import CompileCountGuard
 
     sync_san.uninstall()
     lock_san.uninstall()
-    compile_viol = CompileCountGuard(engine).check()
+    compile_viol = (
+        CompileCountGuard(engine).check() if engine is not None else []
+    )
     print(f"sanitizers: {lock_san.n_wrapped} locks tracked, "
           f"sync counts {dict(sorted(sync_san.counts.items()))}")
     violations = (
@@ -720,6 +724,34 @@ def cmd_lint(args) -> int:
     return graftlint.main(argv)
 
 
+def cmd_audit(args) -> int:
+    """jaxpr-level static audit of the serving program surface
+    (graftaudit): traces every family the engine can emit as abstract
+    avals and checks dtype promotion, donation, collective
+    signatures, host callbacks, the compile-surface bounds, and the
+    per-family memory/flop budgets in .graftaudit.json. Nothing is
+    executed; see README "Correctness tooling"."""
+    # the fake-device XLA_FLAGS bootstrap for the TP surface lives in
+    # __main__.py: it must run before the package (and with it jax)
+    # is imported, which has already happened by the time we get here
+    from deeplearning4j_tpu.analysis import audit as graftaudit
+
+    argv = []
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.strict:
+        argv.append("--strict")
+    if args.full_budgets:
+        argv.append("--full-budgets")
+    if args.json_out:
+        argv += ["--json-out", args.json_out]
+    return graftaudit.main(argv)
+
+
 def cmd_router(args) -> int:
     """Run the prefix-affinity replica router in front of N running
     `serve` processes. The router never loads a model: it forwards
@@ -732,6 +764,17 @@ def cmd_router(args) -> int:
 
     if args.log_json:
         configure_json_logging()
+    sans = None
+    if args.sanitize:
+        from deeplearning4j_tpu.analysis.sanitizers import (
+            LockSanitizer,
+            SyncSanitizer,
+        )
+
+        # install BEFORE the router builds its locks: wrap_lock only
+        # instruments locks created while a sanitizer is active
+        sans = (LockSanitizer().install(), SyncSanitizer().install())
+        print("sanitizers: lock + sync active (development mode)")
     try:
         router = ReplicaRouter(
             args.replica,
@@ -763,6 +806,8 @@ def cmd_router(args) -> int:
             router.stop()
     else:
         router.serve_forever()
+    if sans is not None:
+        return _report_sanitizers(None, *sans)
     return 0
 
 
@@ -1103,6 +1148,12 @@ def main(argv: list[str] | None = None) -> int:
     r.add_argument("--port-file", default=None, metavar="PATH",
                    help="write the bound address as JSON to PATH once "
                    "listening (for harnesses using --port 0)")
+    r.add_argument("--sanitize", action="store_true",
+                   help="development mode: enable the runtime "
+                   "sanitizers (lock-order + lockset tracking, "
+                   "blocking-sync budgets) on the router's own "
+                   "threads and exit nonzero at shutdown if any "
+                   "violation was recorded")
     r.set_defaults(fn=cmd_router)
 
     L = sub.add_parser(
@@ -1126,6 +1177,29 @@ def main(argv: list[str] | None = None) -> int:
                    help="also fail on stale baseline entries and TODO "
                    "reasons (CI mode)")
     L.set_defaults(fn=cmd_lint)
+
+    A = sub.add_parser(
+        "audit",
+        help="statically audit every compiled program family the "
+        "serving engine can emit (graftaudit: jaxpr dtype/donation/"
+        "collective/callback/surface checks + memory/flop budgets); "
+        "exits 1 on findings",
+    )
+    A.add_argument("--baseline", default=None, metavar="PATH",
+                   help="budget baseline JSON (default: "
+                   ".graftaudit.json at the repo root)")
+    A.add_argument("--no-baseline", action="store_true",
+                   help="skip baseline comparison entirely")
+    A.add_argument("--write-baseline", action="store_true",
+                   help="(re)write the baseline from this run")
+    A.add_argument("--strict", action="store_true",
+                   help="also fail on stale baseline entries (CI mode)")
+    A.add_argument("--full-budgets", action="store_true",
+                   help="compile every program for budgets, not just "
+                   "each family's envelope")
+    A.add_argument("--json-out", default=None, metavar="PATH",
+                   help="write the full report as JSON (CI artifact)")
+    A.set_defaults(fn=cmd_audit)
 
     # add_help=False so `bench -h` reaches bench.py's parser, which
     # documents --model/--batch/--dtype

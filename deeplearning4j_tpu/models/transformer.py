@@ -603,6 +603,32 @@ def _tp_replicate(x, tp_mesh):
     )
 
 
+def tp_collective_contract(
+    cfg: TransformerConfig, n_substeps: int = 1,
+    scanned: bool = False,
+) -> dict[str, int]:
+    """The DECLARED collective signature of one TP serving program with
+    ``n_substeps`` fused decode substeps (the contract the static
+    auditor enforces — see ``analysis/audit.py``).
+
+    The exact-TP layout emits exactly one replication constraint
+    (:func:`_tp_replicate`, lowering to ``sharding_constraint``) per
+    sharded contraction: the attention output and the gelu hidden in
+    each layer, plus the logits at the tail — ``2 * n_layers + 1`` per
+    substep. ``scanned`` is for programs that run the blocks under one
+    ``lax.scan`` (prefill with ``cfg.scan_layers``): the two per-layer
+    constraints then appear ONCE in the scan body jaxpr, so the
+    syntactic count is ``2 + 1`` regardless of depth. Anything else (a
+    stray ``psum``, an extra gather, a dropped constraint) changes the
+    flop association and silently breaks the byte-exact TP=N ≡ TP=1
+    parity bar, so drift from this count is a hard audit failure, not
+    a tunable."""
+    per_layer = 1 if scanned else cfg.n_layers
+    return {
+        "sharding_constraint": n_substeps * (2 * per_layer + 1),
+    }
+
+
 def _mlp(p, h_in, tp_mesh=None, delta1=None, sel=None):
     """Shared dense FFN (gelu) over (..., D) activations.
 

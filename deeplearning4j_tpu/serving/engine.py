@@ -169,6 +169,350 @@ _NO_EOS = -1
 _log = logging.getLogger(__name__)
 
 
+#: Declared donation intent per program family: the argnums each
+#: family donates ON TPU (CPU jit cannot alias donated buffers, so the
+#: engine passes () there — same programs, no aliasing). This table IS
+#: the contract the static donation audit (analysis/audit.py) checks
+#: against each family's traced avals: every donated argument must be
+#: consumable by an output of matching shape/dtype, or the donation is
+#: dead weight ("donation not used") and the cache stops updating in
+#: place.
+PROGRAM_DONATION: dict[str, tuple[int, ...]] = {
+    # step/replay thread the pooled caches + per-slot device state
+    "step": (1, 2, 3, 4, 5),          # caches, logits, pos, active, budget
+    "replay": (1, 2),                 # caches, logits
+    "deactivate": (0,),               # active mask
+    # admission programs donate the pool state sextuple
+    "prefill": (0, 1, 2, 3, 4, 5),
+    "insert": (0, 1, 2, 3, 4, 5),
+    "hit_insert": (0, 1, 2, 3, 4, 5),
+    "batch_prefill": (0, 1, 2, 3, 4, 5),
+    "batch_hit": (0, 1, 2, 3, 4, 5),
+    # segment store replaces the region functionally
+    "seg_store": (0,),
+    # pure reads
+    "chunk": (),
+    "seg_fetch": (),
+    "logit_row": (),
+}
+
+
+# -- program-family factories ----------------------------------------------
+#
+# Every compiled program the engine can emit is built by one of these
+# module-level factories. The engine's jit caches call them with its
+# own closures; the program-surface registry (analysis/programs.py)
+# calls the SAME factories with abstract avals — so the audited
+# programs are the live programs by construction, not by transcription.
+
+
+def build_step_program(fwd1, horizon: int, temperature: float,
+                       top_k: int | None, approx_top_k: bool):
+    """K fused decode substeps in one program. The carry — caches,
+    pending logits, positions, active mask, remaining budget — lives
+    entirely on device; ``eos`` is per-slot data. The chain is unrolled
+    (not ``lax.scan``) so XLA keeps in-place cache updates; the layer
+    loop inside ``fwd1`` is already unrolled for the same reason."""
+
+    def step(params, caches, logits, pos, active, budget, eos,
+             slot_keys_raw, adapters):
+        # per-slot keys (raw uint32 rows, host-persisted): token i
+        # of slot s is sampled with fold_in(key_s, position) — a
+        # pure function of the slot's admission key and its stream
+        # position, so the key stream is invariant to batch
+        # composition, horizon K, and crash-recovery replay
+        keys = (
+            jax.random.wrap_key_data(slot_keys_raw)
+            if temperature != 0 else None
+        )
+        toks_all = []
+        for k in range(horizon):
+            filt = _top_k_filter(logits, top_k, approx_top_k)
+            if temperature == 0:
+                toks = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+            else:
+                tok_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+                toks = jax.vmap(
+                    lambda kk, lg: jax.random.categorical(kk, lg)
+                )(tok_keys, filt / temperature).astype(jnp.int32)
+            # inactive slots decode token 0 at their frozen
+            # position — shape stability; the garbage row they
+            # write stays inside their own slab and is wiped by the
+            # next admission's prefill insert
+            toks = jnp.where(active, toks, 0)
+            new_logits, caches = fwd1(
+                params, caches, toks, pos, adapter=adapters
+            )
+            # advance only live slots, then deactivate in-program:
+            # a slot that just emitted EOS or spent its budget
+            # stops mutating for the rest of the horizon
+            pos = jnp.where(active, pos + 1, pos)
+            budget = jnp.where(active, budget - 1, budget)
+            active = active & (toks != eos) & (budget > 0)
+            logits = new_logits
+            toks_all.append(toks)
+        return (caches, logits, pos, active, budget,
+                jnp.stack(toks_all, axis=1))
+
+    return step
+
+
+def build_replay_program(fwd1):
+    """Teacher-forced decode step for stepwise crash recovery: feed
+    RECORDED tokens (no sampling) and freeze the pending-logits rows of
+    slots whose recording is already exhausted — those rows must stay
+    exactly what the slot's last real step produced."""
+
+    def rstep(params, caches, logits, toks, pos, replaying, adapters):
+        new_logits, caches = fwd1(
+            params, caches, toks, pos, adapter=adapters
+        )
+        logits = jnp.where(replaying[:, None], new_logits, logits)
+        return caches, logits
+
+    return rstep
+
+
+def build_deact_program():
+    """Single-slot deactivation: flip one row of the device-resident
+    active mask (retirement between horizons)."""
+    return lambda active, slot: active.at[slot].set(False)
+
+
+def build_prefill_program(do_prefill, init_caches, max_total: int):
+    """Fused admission program for one prompt bucket: prefill-at-
+    batch-1 over the padded prompt, slab insert at the slot index, and
+    the slot's device state (pos/active/budget/eos + pending logits)
+    set in the same dispatch."""
+
+    def prefill(caches, logits, pos, active, budget, eos, params,
+                prompt, last_idx, slot, pos0, max_new, eos_tok,
+                adapter):
+        # batch-1 prefill into a scratch single-slot cache of the
+        # SAME Tpad as the pool, then insert the slab at the slot
+        # index. The slab copy includes the zero rows beyond the
+        # prompt — that wipes the previous occupant's rows, so no
+        # stale state survives reuse. ``last_idx`` points at the true
+        # last prompt row; the padded rows are causally invisible to
+        # it, so the logits are bitwise those of an exact-length
+        # prefill.
+        tmp, lg = do_prefill(
+            params, init_caches(1, max_total), prompt,
+            last_idx=last_idx, adapter=adapter,
+        )
+        caches = jax.tree.map(
+            lambda c, t: lax.dynamic_update_slice(
+                c, t, (0, 0, slot, 0, 0)
+            ),
+            caches, tmp,
+        )
+        logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
+        pos = pos.at[slot].set(pos0)
+        active = active.at[slot].set(True)
+        budget = budget.at[slot].set(max_new)
+        eos = eos.at[slot].set(eos_tok)
+        return caches, logits, pos, active, budget, eos
+
+    return prefill
+
+
+def build_chunk_program(fwd_chunk):
+    """Chunk-at-offset program for the long-prompt path: one
+    ``forward_chunk`` pass over the bucket's rows of a batch-1 scratch
+    cache, returning the (1, V) logits at ``last_idx``."""
+
+    def chunk(params, tmp, toks, pos0, last_idx, adapter):
+        lg, tmp = fwd_chunk(
+            params, tmp, toks, pos0, last_idx=last_idx,
+            adapter=adapter,
+        )
+        return tmp, lg
+
+    return chunk
+
+
+def build_insert_program():
+    """Slab insert + state set (no prefill): lands a scratch cache
+    built by the chunked path — or zeros, for an empty prompt — into
+    the pool at the slot index."""
+
+    def insert(caches, logits, pos, active, budget, eos, tmp, lg,
+               slot, pos0, max_new, eos_tok):
+        caches = jax.tree.map(
+            lambda c, t: lax.dynamic_update_slice(
+                c, t, (0, 0, slot, 0, 0)
+            ),
+            caches, tmp,
+        )
+        logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
+        pos = pos.at[slot].set(pos0)
+        active = active.at[slot].set(True)
+        budget = budget.at[slot].set(max_new)
+        eos = eos.at[slot].set(eos_tok)
+        return caches, logits, pos, active, budget, eos
+
+    return insert
+
+
+def build_hit_insert_program():
+    """FULL-hit admission: one gather/dynamic-update program that
+    copies a segment's whole slab from the region into the pool at the
+    slot index, lands the segment's stored last-row logits, and sets
+    the slot's device state — zero prompt rows computed, zero prefill
+    dispatches."""
+
+    def hit(caches, logits, pos, active, budget, eos, region, seg_lg,
+            seg, slot, pos0, max_new, eos_tok):
+        slab = jax.tree.map(
+            lambda r: lax.dynamic_slice(
+                r, (0, 0, seg, 0, 0),
+                (r.shape[0], r.shape[1], 1, r.shape[3], r.shape[4]),
+            ),
+            region,
+        )
+        caches = jax.tree.map(
+            lambda c, t: lax.dynamic_update_slice(
+                c, t, (0, 0, slot, 0, 0)
+            ),
+            caches, slab,
+        )
+        logits = lax.dynamic_update_slice(logits, seg_lg, (slot, 0))
+        pos = pos.at[slot].set(pos0)
+        active = active.at[slot].set(True)
+        budget = budget.at[slot].set(max_new)
+        eos = eos.at[slot].set(eos_tok)
+        return caches, logits, pos, active, budget, eos
+
+    return hit
+
+
+def build_seg_fetch_program():
+    """Segment fetch: one region slot's slab as a batch-1 scratch
+    cache (the partial-hit path chunk-computes the suffix on top)."""
+
+    def fetch(region, seg):
+        return jax.tree.map(
+            lambda r: lax.dynamic_slice(
+                r, (0, 0, seg, 0, 0),
+                (r.shape[0], r.shape[1], 1, r.shape[3], r.shape[4]),
+            ),
+            region,
+        )
+
+    return fetch
+
+
+def build_seg_store_program():
+    """Segment store: copy a pool slot's slab into the region at the
+    segment index (insert-on-completion). Pool caches are read, not
+    donated; the region is replaced functionally."""
+
+    def store(region, caches, seg, slot):
+        slab = jax.tree.map(
+            lambda c: lax.dynamic_slice(
+                c, (0, 0, slot, 0, 0),
+                (c.shape[0], c.shape[1], 1, c.shape[3], c.shape[4]),
+            ),
+            caches,
+        )
+        return jax.tree.map(
+            lambda r, t: lax.dynamic_update_slice(
+                r, t, (0, 0, seg, 0, 0)
+            ),
+            region, slab,
+        )
+
+    return store
+
+
+def build_logit_row_program():
+    """(1, V) row slice of the pending logits — captured at insert
+    time so a later FULL hit replays the exact prefill logits without
+    recomputing anything."""
+    return lambda lg, slot: lax.dynamic_slice(
+        lg, (slot, 0), (1, lg.shape[1])
+    )
+
+
+def build_batch_prefill_program(do_prefill, init_caches,
+                                max_total: int, nb: int):
+    """BATCHED admission prefill: ``nb`` same-bucket prompts prefilled
+    in one dispatched program (vector per-row last_idx), each row's
+    slab + logits + device state landed at its slot. Group sizes are
+    padded to powers of two (pad rows repeat row 0, re-writing
+    identical values), so the program count stays
+    O(buckets x log n_slots)."""
+
+    def bprefill(caches, logits, pos, active, budget, eos, params,
+                 prompts, last_idx, slots, pos0, max_new, eos_toks,
+                 adapters):
+        tmp, lg = do_prefill(
+            params, init_caches(nb, max_total), prompts,
+            last_idx=last_idx, adapter=adapters,
+        )
+        for r in range(nb):
+            slab = jax.tree.map(
+                lambda t, r=r: t[:, :, r:r + 1], tmp
+            )
+            caches = jax.tree.map(
+                lambda c, t, r=r: lax.dynamic_update_slice(
+                    c, t, (0, 0, slots[r], 0, 0)
+                ),
+                caches, slab,
+            )
+            logits = lax.dynamic_update_slice(
+                logits, lg[r:r + 1], (slots[r], 0)
+            )
+            pos = pos.at[slots[r]].set(pos0[r])
+            active = active.at[slots[r]].set(True)
+            budget = budget.at[slots[r]].set(max_new[r])
+            eos = eos.at[slots[r]].set(eos_toks[r])
+        return caches, logits, pos, active, budget, eos
+
+    return bprefill
+
+
+def build_batch_hit_program(fwd_chunk, nb: int):
+    """BATCHED partial-hit admission for ``nb`` requests sharing the
+    same cached-prefix length L and suffix bucket: one gather pulls
+    each row's segment slab from the region, one ``forward_chunk`` at
+    scalar pos0=L (vector per-row last_idx) computes all the uncached
+    suffixes, and each row lands at its slot. The common case — many
+    requests behind one system prompt — gathers the SAME segment nb
+    times."""
+
+    def bhit(caches, logits, pos, active, budget, eos, params, region,
+             seg_idx, toks, p0, last_idx, slots, posf, max_new,
+             eos_toks, adapters):
+        tmp = jax.tree.map(
+            lambda r_: jnp.take(r_, seg_idx, axis=2), region
+        )
+        lg, tmp = fwd_chunk(
+            params, tmp, toks, p0, last_idx=last_idx,
+            adapter=adapters,
+        )
+        for r in range(nb):
+            slab = jax.tree.map(
+                lambda t, r=r: t[:, :, r:r + 1], tmp
+            )
+            caches = jax.tree.map(
+                lambda c, t, r=r: lax.dynamic_update_slice(
+                    c, t, (0, 0, slots[r], 0, 0)
+                ),
+                caches, slab,
+            )
+            logits = lax.dynamic_update_slice(
+                logits, lg[r:r + 1], (slots[r], 0)
+            )
+            pos = pos.at[slots[r]].set(posf[r])
+            active = active.at[slots[r]].set(True)
+            budget = budget.at[slots[r]].set(max_new[r])
+            eos = eos.at[slots[r]].set(eos_toks[r])
+        return caches, logits, pos, active, budget, eos
+
+    return bhit
+
+
 class _SlotState:
     """Host-side record for one occupied slot."""
 
@@ -545,19 +889,22 @@ class ServingEngine:
 
         # donating the cache + per-slot state lets XLA update them in
         # place (the cache is the dominant allocation); CPU jit can't
-        # alias donated buffers and would warn every call
-        tpu = jax.devices()[0].platform == "tpu"
-        self._state_donate = (1, 2, 3, 4, 5) if tpu else ()
+        # alias donated buffers and would warn every call. The donated
+        # argnums per family are DECLARED in PROGRAM_DONATION — the
+        # static donation audit checks that table against the traced
+        # programs, so drift between intent and program shape fails CI.
+        self._tpu = jax.devices()[0].platform == "tpu"
+        self._state_donate = self._donate("step")
         # one compiled step program per horizon ACTUALLY used: just
         # {K} static, {1, K} with the adaptive horizon
         self._step_fns: dict[int, object] = {}
         self._replay_fn = jax.jit(
-            self._build_replay_step(),
-            donate_argnums=(1, 2) if tpu else (),
+            build_replay_program(self._fwd1),
+            donate_argnums=self._donate("replay"),
         )
         self._deact_fn = jax.jit(
-            lambda active, slot: active.at[slot].set(False),
-            donate_argnums=(0,) if tpu else (),
+            build_deact_program(),
+            donate_argnums=self._donate("deactivate"),
         )
         self._prefill_fns: dict[int, object] = {}
         self._chunk_fns: dict[int, object] = {}
@@ -568,7 +915,7 @@ class ServingEngine:
         self._seg_store_fn = None
         self._seg_fetch_fn = None
         self._logit_row_fn = None
-        self._admit_donate = (0, 1, 2, 3, 4, 5) if tpu else ()
+        self._admit_donate = self._donate("prefill")
 
     def _register_gauges(self) -> None:
         """Live-state gauges on the metrics registry: scrapes read
@@ -614,6 +961,12 @@ class ServingEngine:
             reg.gauge(
                 "serve_tenants", "Configured tenants in the registry.",
             ).set_function(lambda: len(self.tenancy))
+            # declare per-tenant SLOs so every /metrics render derives
+            # serve_tenant_slo_burn{tenant} from the observed p99s
+            for tid in self.tenancy.tenant_ids():
+                t = self.tenancy.get(tid)
+                if t.slo_p99_tpot_s is not None:
+                    self.metrics.set_tenant_slo(tid, t.slo_p99_tpot_s)
         reg.gauge(
             "serve_decode_horizon_current",
             "Decode substeps fused into the next horizon dispatch "
@@ -648,6 +1001,15 @@ class ServingEngine:
         )
 
     # -- compiled programs -------------------------------------------------
+    #
+    # Program BODIES live in the module-level build_*_program factories
+    # so the static auditor traces the exact functions the engine jits;
+    # these methods only cache the jitted callables per family key.
+
+    def _donate(self, family: str) -> tuple[int, ...]:
+        """Declared donation for one program family — active on TPU,
+        () on CPU (jit can't alias donated buffers there)."""
+        return PROGRAM_DONATION[family] if self._tpu else ()
 
     def _step_fn_for(self, horizon: int):
         """The compiled fused-step program for ``horizon`` substeps
@@ -656,353 +1018,107 @@ class ServingEngine:
         fn = self._step_fns.get(horizon)
         if fn is None:
             fn = jax.jit(
-                self._build_step(horizon),
+                build_step_program(
+                    self._fwd1, horizon, self.temperature, self.top_k,
+                    self.approx_top_k,
+                ),
                 donate_argnums=self._state_donate,
             )
             self._step_fns[horizon] = fn
         return fn
 
-    def _build_step(self, horizon: int):
-        """K fused decode substeps in one program. The carry —
-        caches, pending logits, positions, active mask, remaining
-        budget — lives entirely on device; ``eos`` is per-slot data.
-        The chain is unrolled (not ``lax.scan``) so XLA keeps in-place
-        cache updates; the layer loop inside ``fwd1`` is already
-        unrolled for the same reason."""
-        fwd1 = self._fwd1
-        temperature, top_k = self.temperature, self.top_k
-        approx_top_k = self.approx_top_k
-
-        def step(params, caches, logits, pos, active, budget, eos,
-                 slot_keys_raw, adapters):
-            # per-slot keys (raw uint32 rows, host-persisted): token i
-            # of slot s is sampled with fold_in(key_s, position) — a
-            # pure function of the slot's admission key and its stream
-            # position, so the key stream is invariant to batch
-            # composition, horizon K, and crash-recovery replay
-            keys = (
-                jax.random.wrap_key_data(slot_keys_raw)
-                if temperature != 0 else None
-            )
-            toks_all = []
-            for k in range(horizon):
-                filt = _top_k_filter(logits, top_k, approx_top_k)
-                if temperature == 0:
-                    toks = jnp.argmax(filt, axis=-1).astype(jnp.int32)
-                else:
-                    tok_keys = jax.vmap(jax.random.fold_in)(keys, pos)
-                    toks = jax.vmap(
-                        lambda kk, lg: jax.random.categorical(kk, lg)
-                    )(tok_keys, filt / temperature).astype(jnp.int32)
-                # inactive slots decode token 0 at their frozen
-                # position — shape stability; the garbage row they
-                # write stays inside their own slab and is wiped by the
-                # next admission's prefill insert
-                toks = jnp.where(active, toks, 0)
-                new_logits, caches = fwd1(
-                    params, caches, toks, pos, adapter=adapters
-                )
-                # advance only live slots, then deactivate in-program:
-                # a slot that just emitted EOS or spent its budget
-                # stops mutating for the rest of the horizon
-                pos = jnp.where(active, pos + 1, pos)
-                budget = jnp.where(active, budget - 1, budget)
-                active = active & (toks != eos) & (budget > 0)
-                logits = new_logits
-                toks_all.append(toks)
-            return (caches, logits, pos, active, budget,
-                    jnp.stack(toks_all, axis=1))
-
-        return step
-
-    def _build_replay_step(self):
-        """Teacher-forced decode step for stepwise crash recovery: feed
-        RECORDED tokens (no sampling) and freeze the pending-logits
-        rows of slots whose recording is already exhausted — those rows
-        must stay exactly what the slot's last real step produced."""
-        fwd1 = self._fwd1
-
-        def rstep(params, caches, logits, toks, pos, replaying,
-                  adapters):
-            new_logits, caches = fwd1(
-                params, caches, toks, pos, adapter=adapters
-            )
-            logits = jnp.where(replaying[:, None], new_logits, logits)
-            return caches, logits
-
-        return rstep
-
     def _prefill_fn(self, bucket: int):
-        """Jitted fused admission program for one prompt bucket:
-        prefill-at-batch-1 over the padded prompt, slab insert at the
-        slot index, and the slot's device state (pos/active/budget/eos
-        + pending logits) set in the same dispatch."""
+        """Jitted fused admission program for one prompt bucket (see
+        :func:`build_prefill_program`)."""
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            do_prefill = self._do_prefill
-            init_caches = self._init_caches
-            max_total = self.max_total
-
-            def prefill(caches, logits, pos, active, budget, eos,
-                        params, prompt, last_idx, slot, pos0, max_new,
-                        eos_tok, adapter):
-                # batch-1 prefill into a scratch single-slot cache of
-                # the SAME Tpad as the pool, then insert the slab at
-                # the slot index. The slab copy includes the zero rows
-                # beyond the prompt — that wipes the previous
-                # occupant's rows, so no stale state survives reuse.
-                # ``last_idx`` points at the true last prompt row; the
-                # padded rows are causally invisible to it, so the
-                # logits are bitwise those of an exact-length prefill.
-                tmp, lg = do_prefill(
-                    params, init_caches(1, max_total), prompt,
-                    last_idx=last_idx, adapter=adapter,
-                )
-                caches = jax.tree.map(
-                    lambda c, t: lax.dynamic_update_slice(
-                        c, t, (0, 0, slot, 0, 0)
-                    ),
-                    caches, tmp,
-                )
-                logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
-                pos = pos.at[slot].set(pos0)
-                active = active.at[slot].set(True)
-                budget = budget.at[slot].set(max_new)
-                eos = eos.at[slot].set(eos_tok)
-                return caches, logits, pos, active, budget, eos
-
-            fn = jax.jit(prefill, donate_argnums=self._admit_donate)
+            fn = jax.jit(
+                build_prefill_program(
+                    self._do_prefill, self._init_caches, self.max_total
+                ),
+                donate_argnums=self._admit_donate,
+            )
             self._prefill_fns[bucket] = fn
         return fn
 
     def _chunk_fn(self, bucket: int):
-        """Jitted chunk-at-offset program for the long-prompt path: one
-        ``forward_chunk`` pass over ``bucket`` rows of a batch-1
-        scratch cache, returning the (1, V) logits at ``last_idx``."""
+        """Jitted chunk-at-offset program for the long-prompt path
+        (see :func:`build_chunk_program`)."""
         fn = self._chunk_fns.get(bucket)
         if fn is None:
-            fwd_chunk = self._fwd_chunk
-
-            def chunk(params, tmp, toks, pos0, last_idx, adapter):
-                lg, tmp = fwd_chunk(
-                    params, tmp, toks, pos0, last_idx=last_idx,
-                    adapter=adapter,
-                )
-                return tmp, lg
-
-            fn = jax.jit(chunk)
+            fn = jax.jit(build_chunk_program(self._fwd_chunk))
             self._chunk_fns[bucket] = fn
         return fn
 
     def _insert(self):
-        """Jitted slab insert + state set (no prefill): lands a scratch
-        cache built by the chunked path — or zeros, for an empty
-        prompt — into the pool at the slot index."""
+        """Jitted slab insert + state set (see
+        :func:`build_insert_program`)."""
         if self._insert_fn is None:
-
-            def insert(caches, logits, pos, active, budget, eos, tmp,
-                       lg, slot, pos0, max_new, eos_tok):
-                caches = jax.tree.map(
-                    lambda c, t: lax.dynamic_update_slice(
-                        c, t, (0, 0, slot, 0, 0)
-                    ),
-                    caches, tmp,
-                )
-                logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
-                pos = pos.at[slot].set(pos0)
-                active = active.at[slot].set(True)
-                budget = budget.at[slot].set(max_new)
-                eos = eos.at[slot].set(eos_tok)
-                return caches, logits, pos, active, budget, eos
-
             self._insert_fn = jax.jit(
-                insert, donate_argnums=self._admit_donate
+                build_insert_program(),
+                donate_argnums=self._donate("insert"),
             )
         return self._insert_fn
 
     def _hit_insert(self):
-        """Jitted FULL-hit admission: one gather/dynamic-update program
-        that copies a segment's whole slab from the region into the
-        pool at the slot index, lands the segment's stored last-row
-        logits, and sets the slot's device state — zero prompt rows
-        computed, zero prefill dispatches."""
+        """Jitted FULL-hit admission (see
+        :func:`build_hit_insert_program`)."""
         if self._hit_insert_fn is None:
-
-            def hit(caches, logits, pos, active, budget, eos, region,
-                    seg_lg, seg, slot, pos0, max_new, eos_tok):
-                slab = jax.tree.map(
-                    lambda r: lax.dynamic_slice(
-                        r, (0, 0, seg, 0, 0),
-                        (r.shape[0], r.shape[1], 1, r.shape[3],
-                         r.shape[4]),
-                    ),
-                    region,
-                )
-                caches = jax.tree.map(
-                    lambda c, t: lax.dynamic_update_slice(
-                        c, t, (0, 0, slot, 0, 0)
-                    ),
-                    caches, slab,
-                )
-                logits = lax.dynamic_update_slice(
-                    logits, seg_lg, (slot, 0)
-                )
-                pos = pos.at[slot].set(pos0)
-                active = active.at[slot].set(True)
-                budget = budget.at[slot].set(max_new)
-                eos = eos.at[slot].set(eos_tok)
-                return caches, logits, pos, active, budget, eos
-
             # donates the pool state only — the region must survive
             self._hit_insert_fn = jax.jit(
-                hit, donate_argnums=self._admit_donate
+                build_hit_insert_program(),
+                donate_argnums=self._donate("hit_insert"),
             )
         return self._hit_insert_fn
 
     def _seg_fetch(self):
-        """Jitted segment fetch: one region slot's slab as a batch-1
-        scratch cache (the partial-hit path chunk-computes the suffix
-        on top of it)."""
+        """Jitted segment fetch (see
+        :func:`build_seg_fetch_program`)."""
         if self._seg_fetch_fn is None:
-
-            def fetch(region, seg):
-                return jax.tree.map(
-                    lambda r: lax.dynamic_slice(
-                        r, (0, 0, seg, 0, 0),
-                        (r.shape[0], r.shape[1], 1, r.shape[3],
-                         r.shape[4]),
-                    ),
-                    region,
-                )
-
-            self._seg_fetch_fn = jax.jit(fetch)
+            self._seg_fetch_fn = jax.jit(build_seg_fetch_program())
         return self._seg_fetch_fn
 
     def _seg_store(self):
-        """Jitted segment store: copy a pool slot's slab into the
-        region at the segment index (insert-on-completion). Pool caches
-        are read, not donated; the region is replaced functionally."""
+        """Jitted segment store (see
+        :func:`build_seg_store_program`)."""
         if self._seg_store_fn is None:
-            tpu = jax.devices()[0].platform == "tpu"
-
-            def store(region, caches, seg, slot):
-                slab = jax.tree.map(
-                    lambda c: lax.dynamic_slice(
-                        c, (0, 0, slot, 0, 0),
-                        (c.shape[0], c.shape[1], 1, c.shape[3],
-                         c.shape[4]),
-                    ),
-                    caches,
-                )
-                return jax.tree.map(
-                    lambda r, t: lax.dynamic_update_slice(
-                        r, t, (0, 0, seg, 0, 0)
-                    ),
-                    region, slab,
-                )
-
             self._seg_store_fn = jax.jit(
-                store, donate_argnums=(0,) if tpu else ()
+                build_seg_store_program(),
+                donate_argnums=self._donate("seg_store"),
             )
         return self._seg_store_fn
 
     def _logit_row(self):
-        """Jitted (1, V) row slice of the pending logits — captured at
-        insert time so a later FULL hit replays the exact prefill
-        logits without recomputing anything."""
+        """Jitted (1, V) pending-logits row slice (see
+        :func:`build_logit_row_program`)."""
         if self._logit_row_fn is None:
-            self._logit_row_fn = jax.jit(
-                lambda lg, slot: lax.dynamic_slice(
-                    lg, (slot, 0), (1, lg.shape[1])
-                )
-            )
+            self._logit_row_fn = jax.jit(build_logit_row_program())
         return self._logit_row_fn
 
     def _batch_prefill_fn(self, bucket: int, nb: int):
-        """Jitted BATCHED admission prefill: ``nb`` same-bucket prompts
-        prefilled in one dispatched program (vector per-row last_idx),
-        each row's slab + logits + device state landed at its slot.
-        Group sizes are padded to powers of two (pad rows repeat row 0,
-        re-writing identical values), so the program count stays
-        O(buckets x log n_slots)."""
+        """Jitted BATCHED admission prefill (see
+        :func:`build_batch_prefill_program`)."""
         fn = self._batch_prefill_fns.get((bucket, nb))
         if fn is None:
-            do_prefill = self._do_prefill
-            init_caches = self._init_caches
-            max_total = self.max_total
-
-            def bprefill(caches, logits, pos, active, budget, eos,
-                         params, prompts, last_idx, slots, pos0,
-                         max_new, eos_toks, adapters):
-                tmp, lg = do_prefill(
-                    params, init_caches(nb, max_total), prompts,
-                    last_idx=last_idx, adapter=adapters,
-                )
-                for r in range(nb):
-                    slab = jax.tree.map(
-                        lambda t, r=r: t[:, :, r:r + 1], tmp
-                    )
-                    caches = jax.tree.map(
-                        lambda c, t, r=r: lax.dynamic_update_slice(
-                            c, t, (0, 0, slots[r], 0, 0)
-                        ),
-                        caches, slab,
-                    )
-                    logits = lax.dynamic_update_slice(
-                        logits, lg[r:r + 1], (slots[r], 0)
-                    )
-                    pos = pos.at[slots[r]].set(pos0[r])
-                    active = active.at[slots[r]].set(True)
-                    budget = budget.at[slots[r]].set(max_new[r])
-                    eos = eos.at[slots[r]].set(eos_toks[r])
-                return caches, logits, pos, active, budget, eos
-
-            fn = jax.jit(bprefill, donate_argnums=self._admit_donate)
+            fn = jax.jit(
+                build_batch_prefill_program(
+                    self._do_prefill, self._init_caches,
+                    self.max_total, nb,
+                ),
+                donate_argnums=self._admit_donate,
+            )
             self._batch_prefill_fns[(bucket, nb)] = fn
         return fn
 
     def _batch_hit_fn(self, bucket: int, nb: int):
-        """Jitted BATCHED partial-hit admission for ``nb`` requests
-        sharing the same cached-prefix length L and suffix bucket: one
-        gather pulls each row's segment slab from the region, one
-        ``forward_chunk`` at scalar pos0=L (vector per-row last_idx)
-        computes all the uncached suffixes, and each row lands at its
-        slot. The common case — many requests behind one system
-        prompt — gathers the SAME segment nb times."""
+        """Jitted BATCHED partial-hit admission (see
+        :func:`build_batch_hit_program`)."""
         fn = self._batch_hit_fns.get((bucket, nb))
         if fn is None:
-            fwd_chunk = self._fwd_chunk
-
-            def bhit(caches, logits, pos, active, budget, eos, params,
-                     region, seg_idx, toks, p0, last_idx, slots, posf,
-                     max_new, eos_toks, adapters):
-                tmp = jax.tree.map(
-                    lambda r_: jnp.take(r_, seg_idx, axis=2), region
-                )
-                lg, tmp = fwd_chunk(
-                    params, tmp, toks, p0, last_idx=last_idx,
-                    adapter=adapters,
-                )
-                for r in range(nb):
-                    slab = jax.tree.map(
-                        lambda t, r=r: t[:, :, r:r + 1], tmp
-                    )
-                    caches = jax.tree.map(
-                        lambda c, t, r=r: lax.dynamic_update_slice(
-                            c, t, (0, 0, slots[r], 0, 0)
-                        ),
-                        caches, slab,
-                    )
-                    logits = lax.dynamic_update_slice(
-                        logits, lg[r:r + 1], (slots[r], 0)
-                    )
-                    pos = pos.at[slots[r]].set(posf[r])
-                    active = active.at[slots[r]].set(True)
-                    budget = budget.at[slots[r]].set(max_new[r])
-                    eos = eos.at[slots[r]].set(eos_toks[r])
-                return caches, logits, pos, active, budget, eos
-
-            fn = jax.jit(bhit, donate_argnums=self._admit_donate)
+            fn = jax.jit(
+                build_batch_hit_program(self._fwd_chunk, nb),
+                donate_argnums=self._admit_donate,
+            )
             self._batch_hit_fns[(bucket, nb)] = fn
         return fn
 
@@ -1146,7 +1262,7 @@ class ServingEngine:
     # -- retirement --------------------------------------------------------
 
     def _store_result(self, req: Request, tokens: list[int]) -> None:
-        stream = np.concatenate([req.prompt, np.asarray(tokens, np.int32)])
+        stream = np.concatenate([req.prompt, np.asarray(tokens, np.int32)])  # lint: sync-ok host token list, no device buffer involved
         with self._results_lock:
             note_access("engine.results", write=True)
             self._results[req.id] = stream
@@ -1232,7 +1348,7 @@ class ServingEngine:
             vectors = {}
             for w in req.words:
                 v = emb.get_word_vector(w)
-                vectors[w] = None if v is None else np.asarray(v)
+                vectors[w] = None if v is None else np.asarray(v)  # lint: sync-ok host embedding table row, no device buffer
             req.result = vectors
             req.status = RequestStatus.FINISHED
             self.metrics.record_embedding(

@@ -144,6 +144,9 @@ class ServingMetrics:
         self._tlock = wrap_lock(threading.Lock(), "metrics._tlock")
         self._tenants: dict[str, dict] = {}  # guarded-by: _tlock
         self.n_rejections: dict[str, int] = {}  # guarded-by: _tlock
+        # tenant_id -> p99 TPOT objective in seconds; the burn gauge is
+        # derived from the per-tenant reservoir at render time
+        self._tenant_slos: dict[str, float] = {}  # guarded-by: _tlock
         self._step = 0
 
         # Prometheus instruments (get-or-create: a shared registry can
@@ -215,6 +218,11 @@ class ServingMetrics:
         self._c_tenant_tokens = reg.counter(
             "serve_tenant_tokens_total",
             "Tokens generated per tenant.", ("tenant",),
+        )
+        self._g_slo_burn = reg.gauge(
+            "serve_tenant_slo_burn",
+            "Observed p99 TPOT / tenant SLO objective (> 1 = violating).",
+            ("tenant",),
         )
         self._c_embeddings = reg.counter(
             "serve_embeddings_total",
@@ -416,9 +424,30 @@ class ServingMetrics:
             self.n_expired += 1
             self._emit("expired_total", self.n_expired)
 
+    def set_tenant_slo(self, tenant_id: str, p99_tpot_s: float) -> None:
+        """Declare a tenant's p99 TPOT objective (seconds). From then
+        on every render publishes ``serve_tenant_slo_burn{tenant}`` =
+        observed p99 / objective, once the tenant has TPOT samples."""
+        if p99_tpot_s <= 0:
+            raise ValueError("p99_tpot_s must be > 0")
+        with self._tlock:
+            self._tenant_slos[tenant_id] = float(p99_tpot_s)
+
+    def _update_slo_burn(self) -> None:
+        """Refresh the burn-rate gauges from the per-tenant TPOT
+        reservoirs. Tenants with an SLO but no samples yet publish
+        nothing (a 0 would read as a perfect SLO with zero traffic)."""
+        with self._tlock:
+            for tid, target in self._tenant_slos.items():
+                st = self._tenants.get(tid)
+                if st is not None and st["tpot"]:
+                    burn = _pct(st["tpot"], 99) / target
+                    self._g_slo_burn.set(burn, tenant=tid)
+
     def render_prometheus(self) -> str:
         """The backing registry in Prometheus text format (what the
         serving server returns at ``GET /metrics``)."""
+        self._update_slo_burn()
         return self.registry.render()
 
     def summary(self) -> dict:
@@ -469,6 +498,9 @@ class ServingMetrics:
                     if st["tpot"]:
                         t["tpot_p50_s"] = _pct(st["tpot"], 50)
                         t["tpot_p99_s"] = _pct(st["tpot"], 99)
+                        slo = self._tenant_slos.get(tid)
+                        if slo is not None:
+                            t["slo_burn"] = t["tpot_p99_s"] / slo
                     if st["queue_delay"]:
                         t["queue_delay_p50_s"] = _pct(st["queue_delay"], 50)
                         t["queue_delay_p99_s"] = _pct(st["queue_delay"], 99)

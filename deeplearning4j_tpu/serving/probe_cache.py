@@ -30,12 +30,16 @@ def probe_key(probe: str, cfg_json: str, **geometry) -> str:
     """Stable digest for one probe verdict: the probe name, the full
     model config JSON, the backend platform + participating device
     count, and any program-geometry knobs the probe's compiled programs
-    depend on (bucket sizes, slot counts, TP width...)."""
+    depend on (bucket sizes, slot counts, TP width...). The JAX version
+    participates too: a verdict reflects the compiler that produced it,
+    and an upgrade may change fusion/reduction order, so stale verdicts
+    must miss rather than vouch for programs they never saw."""
     import jax
 
     payload = {
         "probe": probe,
         "cfg": cfg_json,
+        "jax": jax.__version__,
         "platform": jax.devices()[0].platform,
         **{k: geometry[k] for k in sorted(geometry)},
     }

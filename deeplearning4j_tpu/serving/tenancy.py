@@ -50,7 +50,10 @@ class TenantConfig:
     engine-wide limit only). ``rate`` is the sustained token budget in
     tokens/second with ``burst`` headroom (None = unmetered).
     ``default_adapter`` is the LoRA bank row applied when a request
-    does not name one (0 = base model)."""
+    does not name one (0 = base model). ``slo_p99_tpot_s`` is the
+    tenant's p99 time-per-output-token objective in seconds (None = no
+    SLO); the metrics layer exports observed-p99 / objective as a
+    burn-rate gauge (> 1 means the SLO is being violated)."""
 
     tenant_id: str
     api_key: str | None = None
@@ -60,6 +63,7 @@ class TenantConfig:
     rate: float | None = None
     burst: float | None = None
     default_adapter: int = 0
+    slo_p99_tpot_s: float | None = None
 
     def __post_init__(self):
         if not self.tenant_id:
@@ -89,6 +93,10 @@ class TenantConfig:
         if self.default_adapter < 0:
             raise ValueError(
                 f"tenant {self.tenant_id}: default_adapter must be >= 0"
+            )
+        if self.slo_p99_tpot_s is not None and self.slo_p99_tpot_s <= 0:
+            raise ValueError(
+                f"tenant {self.tenant_id}: slo_p99_tpot_s must be > 0"
             )
 
 
@@ -143,7 +151,8 @@ class TenantRegistry:
         """Build from a parsed JSON config: either a list of tenant
         objects or ``{"tenants": [...]}``. Keys: ``id`` (required),
         ``api_key``, ``priority``, ``weight``, ``max_slots``,
-        ``rate_tokens_per_s``, ``burst_tokens``, ``default_adapter``."""
+        ``rate_tokens_per_s``, ``burst_tokens``, ``default_adapter``,
+        ``slo_p99_tpot_s``."""
         if isinstance(obj, dict):
             obj = obj["tenants"]
         tenants = []
@@ -158,6 +167,7 @@ class TenantRegistry:
                     rate=item.get("rate_tokens_per_s"),
                     burst=item.get("burst_tokens"),
                     default_adapter=int(item.get("default_adapter", 0)),
+                    slo_p99_tpot_s=item.get("slo_p99_tpot_s"),
                 )
             )
         return cls(tenants, clock=clock)

@@ -254,6 +254,50 @@ def test_quota_429_and_refill():
     assert s["tenants"]["open"]["n_finished"] == 1
 
 
+def test_slo_burn_gauge_from_per_tenant_p99():
+    """A tenant with a p99-TPOT SLO gets a derived
+    ``serve_tenant_slo_burn{tenant}`` gauge at every /metrics render
+    (observed p99 / objective); tenants without an SLO, or with no
+    TPOT samples yet, publish nothing."""
+    tenancy = TenantRegistry([
+        TenantConfig("gold", api_key="g", slo_p99_tpot_s=0.001),
+        TenantConfig("free", api_key="f"),
+    ])
+    engine = ServingEngine(
+        CFG, _params(), n_slots=2, temperature=0.0,
+        scheduler=RequestScheduler(max_queue_depth=64, tenancy=tenancy),
+        tenancy=tenancy,
+    )
+    # SLO declared but no traffic yet -> no gauge line (a 0 would read
+    # as a perfect SLO with zero samples)
+    assert "serve_tenant_slo_burn{" not in engine.metrics.render_prometheus()
+
+    prompt = np.arange(8, dtype=np.int32) % CFG.vocab_size
+    for tid in ("gold", "free", "gold"):
+        engine.submit(Request(prompt=prompt.copy(), max_new=8,
+                              tenant_id=tid))
+    engine.run()
+
+    text = engine.metrics.render_prometheus()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("serve_tenant_slo_burn{")]
+    assert len(lines) == 1 and 'tenant="gold"' in lines[0]
+    burn = float(lines[0].split()[-1])
+    s = engine.metrics.summary()
+    assert burn == pytest.approx(
+        s["tenants"]["gold"]["tpot_p99_s"] / 0.001
+    )
+    assert s["tenants"]["gold"]["slo_burn"] == pytest.approx(burn)
+    assert "slo_burn" not in s["tenants"]["free"]
+    # config plumbing: from_json carries the SLO; validation rejects 0
+    reg = TenantRegistry.from_json(
+        [{"id": "t", "slo_p99_tpot_s": 0.25}]
+    )
+    assert reg.get("t").slo_p99_tpot_s == 0.25
+    with pytest.raises(ValueError, match="slo_p99_tpot_s"):
+        TenantConfig("bad", slo_p99_tpot_s=0.0)
+
+
 def test_max_slots_caps_concurrency():
     """A max_slots=1 tenant never holds two KV slots at once even with
     the pool free, and still finishes everything."""
