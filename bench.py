@@ -1026,6 +1026,162 @@ def _bench_decode_serve_prefix(args, n_slots: int = 16,
     return tok_per_sec, metric, extra
 
 
+def _bench_decode_serve_paged(args, n_slots: int = 16,
+                              n_requests: int = 48,
+                              mean_interarrival_s: float = 0.01):
+    """Block-paged KV serving vs the slab pool, priced on the serve
+    trace the prefix row uses (0.9 shared-prefix traffic, cache ON for
+    both engines — streams are byte-identical by the paged parity
+    probe, so the delta is pure allocator/layout cost). Three stories
+    on one row:
+
+    - ``tok_per_sec`` (the metric) vs ``slab_tok_per_sec``: what the
+      gather-view paged step costs/buys at serving time on this host.
+    - ``capacity``: max concurrent slots at FIXED HBM under an
+      8k-prompt mix — exact metadata arithmetic over both layouts (no
+      8k buffers are allocated): the slab pool strands a full
+      Tpad-row slab per slot however short the request, the paged pool
+      allocates ``ceil((prompt+max_new)/block)`` 512-row blocks and
+      byte-shares the 2k-token common prefix via refcounted aliasing.
+      This is the ``>= 2x`` headline and it is layout math, not a
+      device measurement.
+    - ``int8``: the fused-int8 paged engine's rate plus the exact
+      KV-bytes-per-row ratio vs bf16 (~0.52: int8 bytes + f32 per-row
+      scale planes) — the HBM-stream halving that carries the int8 MBU
+      claim; MBU itself is a TPU-side measurement (see PERF.md).
+    """
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import (
+        init_transformer,
+        quantize_decode_params,
+    )
+    from deeplearning4j_tpu.serving import (
+        Request,
+        RequestScheduler,
+        ServingEngine,
+        ServingMetrics,
+        run_request_trace,
+    )
+
+    cfg, _, p = _decode_bench_cfg(args, batch=1, gqa=True)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    sfx_len = 64
+    pfx_len = _DECODE_PROMPT_LEN - sfx_len
+    shared = rng.integers(0, p["vocab"], (pfx_len,)).astype(np.int32)
+    uniq = rng.integers(
+        0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
+    ).astype(np.int32)
+
+    def make_trace(frac=0.9):
+        reqs = []
+        for i in range(n_requests):
+            if i < int(round(frac * n_requests)):
+                prompt = np.concatenate([shared, uniq[i, :sfx_len]])
+            else:
+                prompt = uniq[i]
+            reqs.append(
+                (float(arrivals[i]),
+                 Request(prompt=prompt, max_new=_DECODE_NEW))
+            )
+        return reqs
+
+    def make_engine(paged, engine_cfg=None, engine_params=None):
+        return ServingEngine(
+            engine_cfg or cfg, engine_params or params, n_slots=n_slots,
+            temperature=1.0, top_k=40,
+            approx_top_k=not args.exact_top_k,
+            prefix_cache=True, paged=paged,
+            scheduler=RequestScheduler(max_queue_depth=n_requests),
+        )
+
+    def point(engine):
+        run_request_trace(engine, make_trace())  # warmup/compile/probes
+        if engine.prefix_cache is not None:
+            engine.prefix_cache.reinit()
+        engine.metrics = ServingMetrics()
+        engine.metrics.decode_horizon = engine.decode_horizon
+        trace = make_trace()
+        t0 = time.perf_counter()
+        results = run_request_trace(engine, trace)
+        dt = time.perf_counter() - t0
+        assert all(r.id in results for _, r in trace)
+        s = engine.metrics.summary()
+        return s["n_generated"] / dt, s, engine
+
+    paged_tps, s, eng = point(make_engine(True))
+    assert eng._paged, "paged engine fell back to slab (probe failed)"
+    slab_tps, _, _ = point(make_engine(False))
+
+    # fused-int8 paged leg: same trace through the int8-KV engine
+    cfg8, _, _ = _decode_bench_cfg(args, batch=1, gqa=True, int8="full")
+    params8 = quantize_decode_params(
+        init_transformer(jax.random.key(0), cfg8), cfg8
+    )
+    int8_tps, _, eng8 = point(make_engine(True, cfg8, params8))
+
+    # -- capacity at fixed HBM, 8k-prompt mix (exact layout math) -----
+    hk = (cfg.d_model // cfg.n_heads) * (cfg.n_kv_heads or cfg.n_heads)
+    row_bytes = cfg.n_layers * 2 * hk * 2           # bf16 K+V per row
+    new8k, blk = 256, 512                           # TPU-tile block
+    tpad8k = -(-(8192 + new8k) // 512) * 512        # pool row rounding
+    ref_slots = 16                                  # fixed reference pool
+    budget = ref_slots * tpad8k * row_bytes         # that slab pool's HBM
+    mix_rng = np.random.default_rng(1)
+    lens = mix_rng.choice([2048, 4096, 8192], 256)  # the 8k-prompt mix
+    shared_len, shared_frac = 2048, 0.9
+    shared_blocks = shared_len // blk
+    used_blocks, slots, shared_resident = 0, 0, False
+    for i, plen in enumerate(lens):
+        is_shared = (i % 10) < int(10 * shared_frac)
+        need = -(-(int(plen) + new8k) // blk)
+        if is_shared:
+            need -= shared_blocks
+            if not shared_resident:
+                need += shared_blocks  # first copy pays for the prefix
+        total = used_blocks + need
+        if total * blk * row_bytes > budget:
+            break
+        used_blocks = total
+        shared_resident = shared_resident or is_shared
+        slots += 1
+    capacity_lift = slots / ref_slots
+
+    # -- int8 KV bytes per row (exact; drives the MBU claim) ----------
+    row_bytes_int8 = cfg.n_layers * 2 * (hk * 1 + 4)  # int8 + f32 scale
+
+    extra = {
+        "slab_tok_per_sec": round(slab_tps, 1),
+        "paged_over_slab": round(paged_tps / max(slab_tps, 1e-9), 3),
+        "int8_paged_tok_per_sec": round(int8_tps, 1),
+        "ttft_p50_s": round(s["ttft_p50_s"], 4),
+        "ttft_p99_s": round(s["ttft_p99_s"], 4),
+        "prefix_hit_rate": round(s.get("prefix_hit_rate", 0.0), 3),
+        "shared_prefix_frac": 0.9,
+        "n_slots": n_slots,
+        "n_requests": n_requests,
+        "block_size": eng.pool.block_size,
+        "capacity": {
+            "hbm_budget_gib": round(budget / 2**30, 2),
+            "mix_prompt_lens": [2048, 4096, 8192],
+            "block_size": blk,
+            "max_slots_slab": ref_slots,
+            "max_slots_paged": slots,
+            "lift": round(capacity_lift, 2),
+        },
+        "kv_bytes_per_row_bf16": row_bytes,
+        "kv_bytes_per_row_int8": row_bytes_int8,
+        "int8_kv_bytes_frac": round(row_bytes_int8 / row_bytes, 3),
+    }
+    del eng8
+    metric = ("transformer_gpt2s_h128_decode_serve_paged_"
+              "tokens_per_sec_per_chip")
+    return paged_tps, metric, extra
+
+
 def _bench_decode_serve_tp(args, n_slots: int = 16, n_requests: int = 32,
                            mean_interarrival_s: float = 0.01):
     """Tensor-parallel serving scaling: the serve trace replayed at a
@@ -1631,7 +1787,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-b1-spec",
     "transformer-decode-gqa-8kctx", "transformer-decode-gqa-8kctx-int8",
     "transformer-decode-serve", "transformer-decode-serve-faults",
-    "transformer-decode-serve-prefix",
+    "transformer-decode-serve-prefix", "transformer-decode-serve-paged",
     "transformer-decode-serve-tp", "transformer-decode-serve-router",
     "transformer-decode-serve-tenant",
 )
@@ -1658,6 +1814,7 @@ _AUTO_DTYPE = {
     "transformer-decode-serve": "bf16",
     "transformer-decode-serve-faults": "bf16",
     "transformer-decode-serve-prefix": "bf16",
+    "transformer-decode-serve-paged": "bf16",
     "transformer-decode-serve-tp": "bf16",
     "transformer-decode-serve-router": "bf16",
     "transformer-decode-serve-tenant": "bf16",
@@ -1774,6 +1931,12 @@ def _run_one_inner(args, jax) -> None:
             _report(args, per_chip, metric, jax, extra=extra,
                     remeasure=lambda: (
                         _bench_decode_serve_prefix(args)[0], None))
+            return
+        if args.model == "transformer-decode-serve-paged":
+            per_chip, metric, extra = _bench_decode_serve_paged(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (
+                        _bench_decode_serve_paged(args)[0], None))
             return
         if args.model == "transformer-decode-serve-tp":
             per_chip, metric, extra = _bench_decode_serve_tp(args)

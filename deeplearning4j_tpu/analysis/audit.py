@@ -312,14 +312,15 @@ def check_surface(cfg, geom, specs) -> list[AuditFinding]:
                 out.add(tuple(int(g) for g in m.groups()))
         return out
 
-    got_step = {k for (k,) in keyed(r"step\[K=(\d+)\]")}
-    if got_step != exp["step"]:
-        f.append(AuditFinding(
-            "surface", "step",
-            f"horizons {sorted(got_step)} != expected "
-            f"{sorted(exp['step'])}",
-        ))
-    for fam in ("prefill", "chunk"):
+    for fam in ("step", "paged_step"):
+        got_step = {k for (k,) in keyed(fam + r"\[K=(\d+)\]")}
+        if got_step != exp[fam]:
+            f.append(AuditFinding(
+                "surface", fam,
+                f"horizons {sorted(got_step)} != expected "
+                f"{sorted(exp[fam])}",
+            ))
+    for fam in ("prefill", "chunk", "paged_prefill"):
         got = {b for (b,) in keyed(fam + r"\[b=(\d+)\]")}
         if got != exp[fam]:
             f.append(AuditFinding(

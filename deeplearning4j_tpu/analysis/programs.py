@@ -30,6 +30,7 @@ from deeplearning4j_tpu.models.transformer import (
     _decode_builder,
     init_lora_bank,
     init_transformer,
+    make_paged_fwd1,
     tp_collective_contract,
 )
 from deeplearning4j_tpu.parallel.mesh import model_parallel_mesh
@@ -37,11 +38,15 @@ from deeplearning4j_tpu.serving.engine import (
     PROGRAM_DONATION,
     build_batch_hit_program,
     build_batch_prefill_program,
+    build_block_copy_program,
     build_chunk_program,
     build_deact_program,
     build_hit_insert_program,
     build_insert_program,
     build_logit_row_program,
+    build_paged_insert_program,
+    build_paged_prefill_program,
+    build_paged_seg_fetch_program,
     build_prefill_program,
     build_replay_program,
     build_seg_fetch_program,
@@ -88,6 +93,19 @@ class ServingGeometry:
     n_adapters: int = 0
     lora_rank: int = 4
     prefix_segments: int = 2
+    # block-paged KV surface (``ServingEngine(paged=True)``): the paged
+    # families ride ALONGSIDE the slab ones — a paged engine still
+    # compiles the chunk/scratch-slab programs (suffix path, probes)
+    paged: bool = False
+    block_size: int = 8
+
+    def blocks_per_slot(self, cfg: TransformerConfig) -> int:
+        """Table width — mirrors ``PagedKVPool``'s Tpad/block split."""
+        return self.tpad(cfg) // self.block_size
+
+    def n_blocks(self, cfg: TransformerConfig) -> int:
+        """Default pool capacity: slab-equivalent + the zero sentinel."""
+        return self.n_slots * self.blocks_per_slot(cfg) + 1
 
     def tpad(self, cfg: TransformerConfig) -> int:
         """Pooled slab row count — mirrors ``init_caches``."""
@@ -195,9 +213,31 @@ class _FamilyAvals:
             (n,) + key_shape, jnp.uint32
         )
         self.adapters = _i32(n)
+        if geom.paged:
+            # blocks leaves mirror PagedKVPool._alloc_caches: the slab
+            # leaf's (slot, Tpad) plane becomes (n_blocks, block_size)
+            nb = geom.n_blocks(cfg)
+            bps = geom.blocks_per_slot(cfg)
+            self.blocks = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    (s.shape[0], s.shape[1], nb, geom.block_size,
+                     s.shape[4]),
+                    s.dtype,
+                ),
+                self.scratch,
+            )
+            self.tables = _i32(geom.n_slots, bps)
+            self.paged_caches = {
+                "blocks": self.blocks, "tables": self.tables
+            }
+            self.seg_row = _i32(bps)
 
     def state(self):
         return (self.caches, self.logits, self.pos, self.active,
+                self.budget, self.eos)
+
+    def paged_state(self):
+        return (self.paged_caches, self.logits, self.pos, self.active,
                 self.budget, self.eos)
 
 
@@ -316,6 +356,71 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
             "logit_row", "logit_row",
             lambda: (build_logit_row_program(), (av.logits, _i32())),
         )
+    if geom.paged and want("paged_step"):
+        for k in geom.horizons():
+            add(
+                f"paged_step[K={k}]", "paged_step",
+                lambda k=k: (
+                    build_step_program(
+                        make_paged_fwd1(av.fwd1), k, geom.temperature,
+                        geom.top_k, geom.approx_top_k,
+                    ),
+                    (av.params, *av.paged_state(), av.slot_keys,
+                     av.adapters),
+                ),
+                n_substeps=k,
+            )
+    if geom.paged and want("paged_replay"):
+        add(
+            "paged_replay", "paged_replay",
+            lambda: (
+                build_replay_program(make_paged_fwd1(av.fwd1)),
+                (av.params, av.paged_caches, av.logits,
+                 _i32(geom.n_slots), av.pos,
+                 jax.ShapeDtypeStruct((geom.n_slots,), jnp.bool_),
+                 av.adapters),
+            ),
+            n_substeps=1,
+        )
+    if geom.paged and want("paged_prefill"):
+        for b in geom.buckets(cfg):
+            add(
+                f"paged_prefill[b={b}]", "paged_prefill",
+                lambda b=b: (
+                    build_paged_prefill_program(
+                        av.do_prefill, av.init_caches, geom.max_total
+                    ),
+                    (*av.paged_state(), av.params, _i32(1, b),
+                     _i32(), _i32(), _i32(), _i32(), _i32(),
+                     _i32(1)),
+                ),
+                n_substeps=1, scanned=cfg.scan_layers,
+            )
+    if geom.paged and want("paged_insert"):
+        add(
+            "paged_insert", "paged_insert",
+            lambda: (
+                build_paged_insert_program(),
+                (*av.paged_state(), av.scratch, av.row_logits,
+                 _i32(), _i32(), _i32(), _i32()),
+            ),
+        )
+    if geom.paged and want("paged_seg_fetch"):
+        add(
+            "paged_seg_fetch", "paged_seg_fetch",
+            lambda: (
+                build_paged_seg_fetch_program(),
+                (av.blocks, av.seg_row),
+            ),
+        )
+    if geom.paged and want("block_copy"):
+        add(
+            "block_copy", "block_copy",
+            lambda: (
+                build_block_copy_program(),
+                (av.blocks, _i32(), _i32()),
+            ),
+        )
     if want("batch_prefill"):
         for b in geom.buckets(cfg):
             for nb in geom.group_sizes():
@@ -372,10 +477,15 @@ def enumerate_programs(
         # GSPMD-partitioned, so TP serving always runs the dense path
         cfg_tp = dataclasses.replace(cfg, decode_kernel=False)
         mesh = model_parallel_mesh(geom.tp)
+        fams = set(_FORWARD_FAMILIES)
+        if geom.paged:
+            # TP paged serving exists (paged-parity TP tests), so its
+            # forward variants carry the same collective contract
+            fams |= {"paged_step", "paged_replay", "paged_prefill"}
         specs += _specs_for(
             _FamilyAvals(cfg_tp, geom, tp_mesh=mesh), geom,
             tp=True, suffix=f"[tp={geom.tp}]",
-            families=_FORWARD_FAMILIES,
+            families=fams,
         )
     if geom.n_adapters > 0:
         # the bank rides inside params; the adapter-index vector is
@@ -402,16 +512,26 @@ def expected_surface(
     mb = max(buckets)
     import math
 
+    singletons = {
+        "replay", "deactivate", "insert", "hit_insert",
+        "seg_fetch", "seg_store", "logit_row",
+    }
+    if geom.paged:
+        singletons |= {
+            "paged_replay", "paged_insert", "paged_seg_fetch",
+            "block_copy",
+        }
     return {
         "step": set(geom.horizons()),
         "prefill": buckets,
         "chunk": buckets,
+        # paged families: empty when the geometry is slab-only, so the
+        # surface diff below stays key-stable across modes
+        "paged_step": set(geom.horizons()) if geom.paged else set(),
+        "paged_prefill": buckets if geom.paged else set(),
         "batch_prefill": {(b, n) for b in buckets for n in groups},
         "batch_hit": {(b, n) for b in buckets for n in groups},
-        "singletons": {
-            "replay", "deactivate", "insert", "hit_insert",
-            "seg_fetch", "seg_store", "logit_row",
-        },
+        "singletons": singletons,
         "log_bound": int(math.log2(mb)) + 1,
     }
 
@@ -421,21 +541,31 @@ def live_engine_families(engine) -> dict[str, set]:
     :func:`expected_surface` vocabulary — the bridge the registry-vs-
     engine test diffs: every observed key must be inside the surface
     the registry enumerates for the same geometry."""
+    paged = bool(getattr(engine, "_paged", False))
     singles = set()
     for name, fn in (
-        ("replay", engine._replay_fn),
+        ("paged_replay" if paged else "replay", engine._replay_fn),
         ("deactivate", engine._deact_fn),
         ("insert", engine._insert_fn),
         ("hit_insert", engine._hit_insert_fn),
         ("seg_fetch", engine._seg_fetch_fn),
         ("seg_store", engine._seg_store_fn),
         ("logit_row", engine._logit_row_fn),
+        ("paged_insert", getattr(engine, "_paged_insert_fn", None)),
+        ("paged_seg_fetch",
+         getattr(engine, "_paged_seg_fetch_fn", None)),
+        ("block_copy", getattr(engine, "_block_copy_fn", None)),
     ):
         if fn is not None:
             singles.add(name)
+    # a paged engine's step-fn cache holds paged_step programs (same
+    # horizon keys, paged fwd1) — report it under the paged family
+    steps = set(engine._step_fns)
     return {
-        "step": set(engine._step_fns),
+        "step": set() if paged else steps,
+        "paged_step": steps if paged else set(),
         "prefill": set(engine._prefill_fns),
+        "paged_prefill": set(getattr(engine, "_paged_prefill_fns", {})),
         "chunk": set(engine._chunk_fns),
         "batch_prefill": set(engine._batch_prefill_fns),
         "batch_hit": set(engine._batch_hit_fns),
@@ -467,7 +597,8 @@ def default_audit_geometry() -> ServingGeometry:
     """The committed audit geometry (see ``.graftaudit.json``): every
     family class is populated — adaptive horizon (two step programs),
     three buckets, batched groups to 4, TP=2 forward variants, one
-    LoRA step variant."""
+    LoRA step variant, and the block-paged families (paged engines are
+    first-class, so their surface is budget-fenced too)."""
     return ServingGeometry(
         n_slots=4,
         max_total=64,
@@ -478,4 +609,6 @@ def default_audit_geometry() -> ServingGeometry:
         n_adapters=2,
         lora_rank=4,
         prefix_segments=2,
+        paged=True,
+        block_size=8,
     )

@@ -537,6 +537,8 @@ def cmd_serve(args) -> int:
         adaptive_horizon=args.adaptive_horizon,
         prefix_cache=args.prefix_cache,
         prefix_cache_tokens=args.prefix_cache_tokens,
+        paged=args.paged,
+        block_size=args.block_size,
         scheduler=RequestScheduler(
             max_queue_depth=args.max_queue,
             prefix_affinity_tokens=args.prefix_affinity_tokens,
@@ -559,6 +561,15 @@ def cmd_serve(args) -> int:
     if lora_bank is not None and engine.n_adapters == 0:
         print("batched LoRA DISABLED (adapter-0 parity probe failed); "
               "serving the base model", file=sys.stderr)
+    if args.paged:
+        if engine._paged:
+            print(f"paged KV: {engine.pool.n_blocks} blocks x "
+                  f"{engine.pool.block_size} tokens (shared pool, "
+                  f"refcounted block tables)")
+        else:
+            print("paged KV DISABLED (parity probe failed or block "
+                  "size does not divide tokens/slot); slab slots",
+                  file=sys.stderr)
     if args.tp > 1:
         if engine.tp == args.tp:
             print(f"tensor parallel: decode sharded over {engine.tp} "
@@ -1009,6 +1020,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="device-side prefix-cache capacity in tokens "
                    "(default: slots x tokens-per-slot, i.e. a region "
                    "as large as the slot pool)")
+    v.add_argument("--paged", action="store_true",
+                   help="block-paged KV: slots hold int32 block tables "
+                   "over one shared refcounted pool instead of fixed "
+                   "slabs — prefix-cache hits alias blocks (zero-copy) "
+                   "and long-context mixes fit more concurrent slots "
+                   "at the same HBM. Gated by a one-time bitwise "
+                   "parity probe; falls back to slab slots")
+    v.add_argument("--block-size", type=int, default=None, metavar="T",
+                   help="tokens per KV block with --paged (default: "
+                   "engine picks; must divide tokens-per-slot)")
     v.add_argument("--prefix-affinity-tokens", type=int, default=0,
                    metavar="K",
                    help="scheduler promotes a queued request whose "

@@ -1372,6 +1372,107 @@ def _decode_builder(cfg: TransformerConfig, tp_mesh=None):
     return forward_one, init_caches, prefill, cast_params
 
 
+# -- block-paged KV views ---------------------------------------------------
+#
+# The paged serving pool (serving/cache_pool.py:PagedKVPool) stores KV
+# as one shared pool of fixed-size blocks addressed by per-slot int32
+# block tables. These helpers bridge that layout and the slab-shaped
+# programs _decode_builder emits: gather the table's blocks into a
+# contiguous per-slot view, run the UNCHANGED slab program, scatter the
+# view back block-by-block. Gather/scatter are pure data movement, so
+# the slab program's arithmetic — and therefore its token streams — is
+# byte-identical by construction; the engine's paged_parity probe pins
+# exactly that. Block 0 is the permanently-zero SENTINEL: unallocated
+# table entries point at it, inactive slots' dead decode writes land in
+# it, and every scatter re-zeroes it in the same program.
+
+
+def paged_gather(blocks, tables):
+    """Contiguous (n_layers, 2, n_slots, Tpad, Hkv*K) slab view of a
+    paged pool: leafwise take of every slot's blocks in table order.
+    Sentinel entries contribute exact-zero rows, matching the zero rows
+    a slab cache holds beyond each slot's writes."""
+    def g(x):
+        nl, two, _, bs, hk = x.shape
+        b, bps = tables.shape
+        v = jnp.take(x, tables.reshape(-1), axis=2)
+        return v.reshape(nl, two, b, bps * bs, hk)
+    return jax.tree.map(g, blocks)
+
+
+def paged_scatter(blocks, tables, view):
+    """Write a slab view back into the block pool (leafwise scatter in
+    table order), then re-zero the sentinel. Duplicate table entries —
+    prefix blocks byte-shared across slots — receive identical bytes
+    from every writer (their view rows were gathered from the same
+    block and decode only rewrites each slot's own position row), so
+    the scatter is order-independent; the sentinel is the one target
+    that can collect differing garbage (inactive slots' dead rows) and
+    is re-zeroed here."""
+    def s(x, v):
+        nl, two, _, bs, hk = x.shape
+        b, bps = tables.shape
+        rows = v.reshape(nl, two, b * bps, bs, hk)
+        out = x.at[:, :, tables.reshape(-1)].set(rows)
+        return out.at[:, :, 0].set(0)
+    return jax.tree.map(s, blocks, view)
+
+
+def paged_slot_gather(blocks, table_row):
+    """One slot's contiguous batch-1 slab (the paged seg_fetch /
+    partial-hit scratch view): take of a single (blocks_per_slot,)
+    table row."""
+    def g(x):
+        nl, two, _, bs, hk = x.shape
+        bps = table_row.shape[0]
+        v = jnp.take(x, table_row, axis=2)
+        return v.reshape(nl, two, 1, bps * bs, hk)
+    return jax.tree.map(g, blocks)
+
+
+def paged_slot_scatter(blocks, table_row, slab):
+    """Land a batch-1 slab (a prefill/chunk scratch cache) in the
+    blocks one table row names, re-zeroing the sentinel. The slab
+    covers the FULL Tpad rows — zeros beyond the prompt included — so
+    the write wipes any stale bytes a reused block carried, exactly as
+    the slab insert wiped whole slabs."""
+    def s(x, v):
+        nl, two, _, bs, hk = x.shape
+        bps = table_row.shape[0]
+        rows = v.reshape(nl, two, bps, bs, hk)
+        out = x.at[:, :, table_row].set(rows)
+        return out.at[:, :, 0].set(0)
+    return jax.tree.map(s, blocks, slab)
+
+
+def paged_block_copy(blocks, src, dst):
+    """Copy one block's rows (``src`` → ``dst``) across every leaf —
+    the full-hit tail-copy / block-zeroing primitive (``src=0`` copies
+    the sentinel, i.e. zeroes ``dst``)."""
+    return jax.tree.map(
+        lambda x: x.at[:, :, dst].set(x[:, :, src]), blocks
+    )
+
+
+def make_paged_fwd1(fwd1):
+    """Paged wrapper of a ``_decode_builder`` ``forward_one``: gather
+    the block pool into the slab view, run the IDENTICAL slab step
+    (same kernel, same arithmetic), scatter back. The paged caches
+    pytree is ``{"blocks": pool leaves, "tables": (n_slots,
+    blocks_per_slot) int32}`` — tables thread through the jitted
+    programs as traced data, so ONE compiled program serves every
+    block mapping."""
+    def paged_fwd1(params, pcaches, token, pos, adapter=None):
+        tables = pcaches["tables"]
+        view = paged_gather(pcaches["blocks"], tables)
+        logits, view = fwd1(params, view, token, pos, adapter=adapter)
+        return logits, {
+            "blocks": paged_scatter(pcaches["blocks"], tables, view),
+            "tables": tables,
+        }
+    return paged_fwd1
+
+
 def _check_decode_len(cfg, tp, max_new):
     total = tp + max_new
     if total > cfg.max_len:

@@ -780,6 +780,127 @@ def flash_decode_attention(
     )(*operands)
 
 
+def _paged_decode_kernel(tbl_ref, q_ref, k_ref, v_ref, pos_ref, *rest,
+                         **kw):
+    """Paged grid step: identical math to ``_flash_decode_kernel`` —
+    the block table ref is consumed by the BlockSpec index maps (it
+    picks WHICH pool block streams in per (batch, tile) cell), never by
+    the body, so the per-tile arithmetic and the online-softmax
+    accumulation order are the slab kernel's, tile for tile."""
+    del tbl_ref  # scalar-prefetch operand: index-map-only
+    _flash_decode_kernel(q_ref, k_ref, v_ref, pos_ref, *rest, **kw)
+
+
+def flash_decode_attention_paged(
+    q: jax.Array,
+    blocks: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    n_kv_heads: int,
+    layer: int = 0,
+    interpret: bool | None = None,
+    block_scales: jax.Array | None = None,
+) -> jax.Array:
+    """One decode step of causal attention against a BLOCK-PAGED KV
+    pool (vLLM-style): K/V live as a shared pool of fixed-size blocks,
+    ``blocks`` (n_layers, 2, n_blocks, block_size, Hkv*K), and each
+    batch row reads the blocks its ``tables`` row names, in table
+    order. The table is a SCALAR-PREFETCH operand
+    (``pltpu.PrefetchScalarGridSpec``): the grid is (B, blocks_per_
+    slot) and the K/V BlockSpec index maps look the pool block id up as
+    ``tables[i, tt]`` — the kernel gathers block-by-block straight from
+    HBM, no contiguous slab view is ever materialized. Entry semantics
+    match the serving pool: entry ``j`` maps logical rows
+    [j*block_size, (j+1)*block_size); id 0 is the all-zero sentinel for
+    unallocated entries (masked out anyway — tiles past ``pos`` skip).
+
+    The per-tile math is ``_flash_decode_kernel``'s, so the output is
+    bitwise ``flash_decode_attention(..., block_t=block_size)`` over
+    the gathered contiguous cache — same tile partitioning, same
+    accumulation order. ``block_scales`` (int8 mode) carries the
+    per-row dequant planes (n_layers, 2, n_blocks, block_size, 1) f32;
+    dequantization stays fused in the inner loop exactly as in the
+    slab kernel, so the HBM stream is the int8 bytes plus the table
+    ints.
+    """
+    if pltpu is None:  # pragma: no cover - CPU envs ship pallas.tpu
+        raise NotImplementedError(
+            "flash_decode_attention_paged needs jax.experimental."
+            "pallas.tpu (PrefetchScalarGridSpec)"
+        )
+    b, g, hk = q.shape
+    bs = blocks.shape[3]
+    bps = tables.shape[1]
+    head_dim = hk // n_kv_heads
+    assert tables.shape == (b, bps), (tables.shape, b)
+    assert bs % 8 == 0, f"block_size must be a multiple of 8, got {bs}"
+    interpret = (not _on_tpu()) if interpret is None else interpret
+    quantized = block_scales is not None
+    kernel = functools.partial(
+        _paged_decode_kernel, block_t=bs, n_t=bps,
+        n_kv_heads=n_kv_heads, head_dim=head_dim, groups=g,
+        scale=1.0 / (head_dim**0.5), quantized=quantized,
+    )
+    pos_arr = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(pos, jnp.int32), (-1, 1)), (b, 1)
+    )
+    if not interpret:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    else:
+        params = None
+    in_specs = [
+        pl.BlockSpec((1, g, hk), lambda i, tt, tbl: (i, 0, 0)),
+        # K and V planes of the one block pool, table-indexed on the
+        # block axis (XLA dedups the duplicated operand)
+        pl.BlockSpec(
+            (1, 1, 1, bs, hk),
+            lambda i, tt, tbl: (layer, 0, tbl[i, tt], 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, 1, 1, bs, hk),
+            lambda i, tt, tbl: (layer, 1, tbl[i, tt], 0, 0),
+        ),
+        pl.BlockSpec((1, 1), lambda i, tt, tbl: (i, 0)),
+    ]
+    operands = [q, blocks, blocks, pos_arr]
+    if quantized:
+        assert blocks.dtype == jnp.int8, blocks.dtype
+        assert block_scales.shape == (
+            blocks.shape[0], 2, blocks.shape[2], bs, 1
+        ), block_scales.shape
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1, 1, bs, 1),
+                lambda i, tt, tbl: (layer, 0, tbl[i, tt], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, bs, 1),
+                lambda i, tt, tbl: (layer, 1, tbl[i, tt], 0, 0),
+            ),
+        ]
+        operands += [block_scales, block_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, bps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, g, hk), lambda i, tt, tbl: (i, 0, 0)),
+        scratch_shapes=[
+            _vmem((1, g * n_kv_heads), jnp.float32),  # m (lane = g*n_kv+h)
+            _vmem((1, g * n_kv_heads), jnp.float32),  # l
+            _vmem((g, hk), jnp.float32),              # acc
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, g, hk), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=params,
+        interpret=interpret,
+    )(jnp.asarray(tables, jnp.int32), *operands)
+
+
 # -- fused embedding dot (word2vec HS read side) ------------------------------
 
 def _emb_dot_kernel(h_ref, w_ref, mask_ref, out_ref):

@@ -9,6 +9,9 @@ Public surface:
   admission-controlled priority queue with backpressure.
 - :class:`~deeplearning4j_tpu.serving.cache_pool.KVSlotPool` — slot
   recycling over one pre-allocated ``init_caches`` buffer.
+- :class:`~deeplearning4j_tpu.serving.cache_pool.PagedKVPool` —
+  block-paged variant: a shared pool of fixed-size KV blocks with
+  refcounted per-slot block tables (``ServingEngine(paged=True)``).
 - :class:`~deeplearning4j_tpu.serving.engine.ServingEngine` — the
   continuous-batching decode loop (admit / fused step / retire).
 - :class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics` —
@@ -40,7 +43,10 @@ Public surface:
   (``models.transformer.init_lora_bank``) per tenant.
 """
 
-from deeplearning4j_tpu.serving.cache_pool import KVSlotPool  # noqa: F401
+from deeplearning4j_tpu.serving.cache_pool import (  # noqa: F401
+    KVSlotPool,
+    PagedKVPool,
+)
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
     run_request_trace,

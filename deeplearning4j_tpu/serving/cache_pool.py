@@ -28,6 +28,8 @@ import math
 import threading
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
 
@@ -45,6 +47,8 @@ class KVSlotPool:
     functionally; with buffer donation the update is in place.
     """
 
+    is_paged = False  # layout flag consumers branch on (PrefixCache)
+
     def __init__(self, cfg: TransformerConfig, n_slots: int, max_total: int,
                  sharding=None):
         if n_slots < 1:
@@ -53,10 +57,17 @@ class KVSlotPool:
         self._init_caches = init_caches
         self._max_total = max_total
         self._sharding = sharding
-        self.caches = self._place(init_caches(n_slots, max_total))
-        kv = self.caches["kv"] if isinstance(self.caches, dict) else self.caches
+        # shape-only pass first: the slab geometry (Tpad row count) is
+        # needed before allocation so subclasses can size their own
+        # layout from it in ``_alloc_caches`` (PagedKVPool carves the
+        # same rows into blocks)
+        shapes = jax.eval_shape(
+            lambda: init_caches(n_slots, max_total)
+        )
+        kv = shapes["kv"] if isinstance(shapes, dict) else shapes
         self.n_slots = n_slots
         self.tpad = kv.shape[3]  # rounded-up row count per slot
+        self.caches = self._alloc_caches()
         # acquire/release/generation run on the engine thread while
         # n_free/n_active/occupancy feed metrics gauges scraped from
         # the sidecar thread — free-list bookkeeping moves under the
@@ -87,6 +98,15 @@ class KVSlotPool:
         if self._sharding is None:
             return caches
         return jax.tree.map(jax.device_put, caches, self._sharding)
+
+    def _alloc_caches(self):
+        """Allocate the pool's device cache, zeroed and placed — the
+        layout hook ``reinit`` and ``__init__`` share (subclasses
+        override it to change the layout without touching the slot
+        bookkeeping)."""
+        return self._place(
+            self._init_caches(self.n_slots, self._max_total)
+        )
 
     @property
     def n_free(self) -> int:
@@ -153,12 +173,243 @@ class KVSlotPool:
         mid-step). Free-list/occupancy bookkeeping is preserved; the
         engine re-prefills every live slot afterwards (see
         ``ServingEngine.recover``)."""
-        self.caches = self._place(
-            self._init_caches(self.n_slots, self._max_total)
-        )
+        self.caches = self._alloc_caches()
 
     def nbytes(self) -> int:
         """Device bytes of the pooled cache (all slots; global logical
         bytes under TP). Precomputed host metadata — never touches the
         live device arrays, so metrics scrapes cost no device sync."""
         return self._nbytes
+
+
+class PagedKVPool(KVSlotPool):
+    """Block-paged KV pool: one shared device pool of fixed-size blocks
+    plus a host-side per-slot int32 block table (vLLM-style paged
+    attention). The slot free-list/generation machinery is inherited
+    unchanged; what changes is the storage behind a slot:
+
+    - ``caches`` leaves are ``(n_layers, 2, n_blocks, block_size, Hkv*K)``
+      (plus the ``(..., 1)`` f32 scale planes in int8 mode) instead of
+      per-slot Tpad slabs;
+    - slot ``s`` owns the rows named by ``tables()[s]`` — a
+      ``blocks_per_slot``-long int32 row where entry ``j`` maps token
+      rows ``[j*block_size, (j+1)*block_size)``; unallocated entries
+      hold 0, the permanently-zero SENTINEL block (block ids are
+      therefore 1-based);
+    - admission allocates only ``ceil((prompt+max_new)/block_size)``
+      blocks instead of a whole Tpad slab, which is where the capacity
+      lift at fixed HBM comes from;
+    - blocks are reference-counted: a cached prefix is byte-SHARED by
+      aliasing its block ids into a hitting slot's table and bumping
+      refcounts (no copy); a block returns to the free heap only when
+      its refcount reaches zero.
+
+    Block ids are handed out lowest-id-first (a heap, like the slot
+    free list) so allocation order is deterministic — the paged
+    extensions of the free-list determinism tests rely on it.
+
+    ``block_size`` must be a power of two dividing Tpad; keeping it a
+    multiple of the engine's admission grain (8 rows) makes every
+    grain-aligned partial-prefix hit block-aligned, so hits are pure
+    aliasing. On TPU the natural size is the flash-decode kernel's time
+    tile (512 for the >=1k-context Tpad grain).
+    """
+
+    is_paged = True
+
+    def __init__(self, cfg: TransformerConfig, n_slots: int,
+                 max_total: int, sharding=None, *, block_size: int = 8,
+                 n_blocks: int | None = None):
+        bs = int(block_size)
+        if bs < 1 or bs & (bs - 1):
+            raise ValueError(
+                f"block_size must be a power of two, got {block_size}"
+            )
+        self.block_size = bs
+        self._requested_blocks = n_blocks
+        super().__init__(cfg, n_slots, max_total, sharding)
+        # host-side paging state (same lock as the slot free list —
+        # metrics gauges scrape block occupancy from a sidecar thread)
+        self._tables = np.zeros(
+            (n_slots, self.blocks_per_slot), np.int32
+        )  # guarded-by: _lock
+        self._refs = np.zeros((self.n_blocks,), np.int32)  # guarded-by: _lock
+        self._refs[0] = 1  # zero sentinel: permanently pinned
+        self._free_blocks = list(range(1, self.n_blocks))  # heap; guarded-by: _lock
+
+    def _alloc_caches(self):
+        if self.block_size > self.tpad or self.tpad % self.block_size:
+            raise ValueError(
+                f"block_size {self.block_size} does not divide the "
+                f"slab row count Tpad={self.tpad}"
+            )
+        self.blocks_per_slot = self.tpad // self.block_size
+        # default capacity matches the slab pool exactly (plus the
+        # sentinel), so a paged pool can always hold what the slab pool
+        # held; callers oversubscribe by passing a smaller n_blocks or
+        # raise n_slots at the same n_blocks
+        self.n_blocks = (
+            self._requested_blocks if self._requested_blocks is not None
+            else self.n_slots * self.blocks_per_slot + 1
+        )
+        if self.n_blocks < 2:
+            raise ValueError(
+                f"n_blocks must be >= 2 (sentinel + one allocatable "
+                f"block), got {self.n_blocks}"
+            )
+        shapes = jax.eval_shape(
+            lambda: self._init_caches(1, self._max_total)
+        )
+        return self._place(jax.tree.map(
+            lambda s: jnp.zeros(
+                (s.shape[0], s.shape[1], self.n_blocks,
+                 self.block_size, s.shape[4]),
+                s.dtype,
+            ),
+            shapes,
+        ))
+
+    # -- block accounting --------------------------------------------------
+
+    def block_nbytes(self) -> int:
+        """Host-metadata byte size of ONE block across all cache leaves
+        (the prefix cache reports its footprint from block counts
+        instead of walking live device arrays)."""
+        return self._nbytes // self.n_blocks
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        """Blocks covering ``n_tokens`` rows (every row a request can
+        ever write — admission sizes this as prompt + max_new)."""
+        return -(-max(0, int(n_tokens)) // self.block_size)
+
+    @property
+    def n_free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free_blocks)
+
+    @property
+    def n_blocks_in_use(self) -> int:
+        """Allocated blocks (sentinel excluded)."""
+        with self._lock:
+            return self.n_blocks - 1 - len(self._free_blocks)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        """Whether the free heap covers a fresh ``n_tokens``-row
+        allocation (the paged admission gate)."""
+        return self.blocks_needed(n_tokens) <= self.n_free_blocks
+
+    def table(self, slot: int) -> np.ndarray:
+        """Snapshot of one slot's block-table row."""
+        with self._lock:
+            return self._tables[slot].copy()
+
+    def tables(self) -> np.ndarray:
+        """Snapshot of the whole (n_slots, blocks_per_slot) table."""
+        with self._lock:
+            return self._tables.copy()
+
+    def slot_blocks(self, slot: int) -> list[int]:
+        """The non-sentinel block ids a slot's table names, in table
+        order."""
+        with self._lock:
+            return [int(b) for b in self._tables[slot] if b]
+
+    def refcount(self, block_id: int) -> int:
+        with self._lock:
+            return int(self._refs[block_id])
+
+    # -- allocation / sharing ----------------------------------------------
+
+    def alloc_slot_blocks(self, slot: int, n_tokens: int,
+                          start: int = 0) -> list[int]:
+        """Allocate private blocks for table entries
+        ``[start, blocks_needed(n_tokens))`` of ``slot`` (lowest block
+        id first) and return them. ``start`` > 0 is the partial-hit
+        path: the first ``start`` entries were aliased from a cached
+        segment and stay untouched. Raises ``RuntimeError`` when the
+        free heap cannot cover the allocation (callers gate admission
+        on :meth:`can_admit`)."""
+        k = self.blocks_needed(n_tokens)
+        if k > self.blocks_per_slot:
+            raise RuntimeError(
+                f"{n_tokens} rows need {k} blocks, slot tables hold "
+                f"{self.blocks_per_slot}"
+            )
+        with self._lock:
+            note_access("pool.blockmap", write=True)
+            need = max(0, k - start)
+            if need > len(self._free_blocks):
+                raise RuntimeError("no free KV blocks")
+            out = []
+            for j in range(start, k):
+                bid = heapq.heappop(self._free_blocks)
+                self._refs[bid] = 1
+                self._tables[slot, j] = bid
+                out.append(bid)
+            return out
+
+    def alias_into_slot(self, slot: int, block_ids, start: int = 0
+                        ) -> None:
+        """Byte-share existing blocks into ``slot``'s table entries
+        ``[start, start+len(block_ids))``: a refcount bump, zero device
+        work. This is how a prefix-cache hit lands its cached rows."""
+        with self._lock:
+            note_access("pool.blockmap", write=True)
+            for j, bid in enumerate(block_ids):
+                self._refs[bid] += 1
+                self._tables[slot, start + j] = bid
+
+    def alloc_blocks(self, k: int) -> list[int]:
+        """Allocate ``k`` blocks owned by no slot (refcount 1) — the
+        prefix cache's segment storage. Freed via :meth:`decref`."""
+        with self._lock:
+            note_access("pool.blockmap", write=True)
+            if k > len(self._free_blocks):
+                raise RuntimeError("no free KV blocks")
+            out = [heapq.heappop(self._free_blocks) for _ in range(k)]
+            for bid in out:
+                self._refs[bid] = 1
+            return out
+
+    def incref(self, block_ids) -> None:
+        with self._lock:
+            note_access("pool.blockmap", write=True)
+            for bid in block_ids:
+                self._refs[bid] += 1
+
+    def decref(self, block_ids) -> None:
+        """Drop one reference per id; blocks reaching zero return to
+        the free heap (eviction frees blocks, not slabs)."""
+        with self._lock:
+            note_access("pool.blockmap", write=True)
+            for bid in block_ids:
+                self._refs[bid] -= 1
+                if self._refs[bid] == 0:
+                    heapq.heappush(self._free_blocks, int(bid))
+
+    def release(self, slot: int) -> None:
+        """Slot free-list release plus block teardown: every non-
+        sentinel table entry drops one reference (shared prefix blocks
+        survive under their other holders; private blocks return to the
+        heap) and the table row resets to the sentinel."""
+        super().release(slot)
+        with self._lock:
+            note_access("pool.blockmap", write=True)
+            for bid in self._tables[slot]:
+                if bid:
+                    self._refs[bid] -= 1
+                    if self._refs[bid] == 0:
+                        heapq.heappush(self._free_blocks, int(bid))
+            self._tables[slot] = 0
+
+    def reinit(self) -> None:
+        """Crash recovery: re-create the block pool zeroed and reset
+        ALL paging state — tables, refcounts, free heap. Slot
+        free-list/occupancy bookkeeping is preserved (the engine
+        re-allocates blocks while re-prefilling each live slot)."""
+        super().reinit()
+        with self._lock:
+            self._tables[:] = 0
+            self._refs[:] = 0
+            self._refs[0] = 1
+            self._free_blocks = list(range(1, self.n_blocks))

@@ -118,6 +118,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 
@@ -131,6 +132,10 @@ from deeplearning4j_tpu.models.transformer import (
     _chunk_builder,
     _decode_builder,
     _top_k_filter,
+    make_paged_fwd1,
+    paged_block_copy,
+    paged_slot_gather,
+    paged_slot_scatter,
     place_serving_tp_params,
     serving_tp_cache_sharding,
 )
@@ -143,7 +148,7 @@ from deeplearning4j_tpu.obs.trace import (
     Tracer,
     slot_track,
 )
-from deeplearning4j_tpu.serving.cache_pool import KVSlotPool
+from deeplearning4j_tpu.serving.cache_pool import KVSlotPool, PagedKVPool
 from deeplearning4j_tpu.serving.faults import (
     EngineCrash,
     FaultInjector,
@@ -194,6 +199,16 @@ PROGRAM_DONATION: dict[str, tuple[int, ...]] = {
     "chunk": (),
     "seg_fetch": (),
     "logit_row": (),
+    # paged families: the caches argument is the {"blocks", "tables"}
+    # dict; donating it donates both leaves — the tables leaf is
+    # consumed by the identity pass-through output, blocks by the
+    # scattered blocks output
+    "paged_step": (1, 2, 3, 4, 5),
+    "paged_replay": (1, 2),
+    "paged_prefill": (0, 1, 2, 3, 4, 5),
+    "paged_insert": (0, 1, 2, 3, 4, 5),
+    "block_copy": (0,),
+    "paged_seg_fetch": (),
 }
 
 
@@ -513,6 +528,91 @@ def build_batch_hit_program(fwd_chunk, nb: int):
     return bhit
 
 
+# -- paged program factories -----------------------------------------------
+#
+# Paged-mode analogues over the {"blocks", "tables"} caches dict. The
+# compute is IDENTICAL to the slab programs — same do_prefill, same
+# fwd1 via make_paged_fwd1's gather/compute/scatter wrapper — only the
+# landing changes: instead of a dynamic-update at the slot's slab, rows
+# scatter into the pool blocks the slot's table row names. Rows past
+# the row's allocated coverage scatter into the zero sentinel (block 0,
+# re-zeroed in-program), so a slot only ever writes blocks it owns.
+
+
+def build_paged_prefill_program(do_prefill, init_caches, max_total: int):
+    """Paged admission prefill: batch-1 prefill into a scratch slab
+    (same as the slab program), then scatter the slab's rows into the
+    slot's table-row blocks. Fresh private blocks get the scratch
+    cache's zero rows beyond the prompt, so no stale bytes from a
+    previous block owner survive reuse."""
+
+    def prefill(caches, logits, pos, active, budget, eos, params,
+                prompt, last_idx, slot, pos0, max_new, eos_tok,
+                adapter):
+        tmp, lg = do_prefill(
+            params, init_caches(1, max_total), prompt,
+            last_idx=last_idx, adapter=adapter,
+        )
+        row = caches["tables"][slot]
+        caches = {
+            "blocks": paged_slot_scatter(caches["blocks"], row, tmp),
+            "tables": caches["tables"],
+        }
+        logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
+        pos = pos.at[slot].set(pos0)
+        active = active.at[slot].set(True)
+        budget = budget.at[slot].set(max_new)
+        eos = eos.at[slot].set(eos_tok)
+        return caches, logits, pos, active, budget, eos
+
+    return prefill
+
+
+def build_paged_insert_program():
+    """Paged insert + state set (no prefill): scatter a batch-1 scratch
+    slab — built by the chunked path or a segment gather — into the
+    slot's table-row blocks and land the pending logits row."""
+
+    def insert(caches, logits, pos, active, budget, eos, tmp, lg,
+               slot, pos0, max_new, eos_tok):
+        row = caches["tables"][slot]
+        caches = {
+            "blocks": paged_slot_scatter(caches["blocks"], row, tmp),
+            "tables": caches["tables"],
+        }
+        logits = lax.dynamic_update_slice(logits, lg, (slot, 0))
+        pos = pos.at[slot].set(pos0)
+        active = active.at[slot].set(True)
+        budget = budget.at[slot].set(max_new)
+        eos = eos.at[slot].set(eos_tok)
+        return caches, logits, pos, active, budget, eos
+
+    return insert
+
+
+def build_paged_seg_fetch_program():
+    """Paged segment fetch: gather a segment's block list (sentinel-
+    padded to full table width, so uncovered rows come back zero) into
+    a batch-1 scratch slab the chunk programs accept unchanged."""
+
+    def fetch(blocks, seg_row):
+        return paged_slot_gather(blocks, seg_row)
+
+    return fetch
+
+
+def build_block_copy_program():
+    """Copy one block's rows to another block across every layer/leaf —
+    the paged segment store's tail privatization (a donor slot keeps
+    writing its tail block past the cached length, so the cache copies
+    that one block instead of aliasing it)."""
+
+    def copy(blocks, src, dst):
+        return paged_block_copy(blocks, src, dst)
+
+    return copy
+
+
 class _SlotState:
     """Host-side record for one occupied slot."""
 
@@ -555,6 +655,26 @@ class _AdmitPlan:
         self.admitted = False  # slot state seated (crash requeue guard)
         self.prefill_s = 0.0
         self.t_pf = 0.0
+
+
+# Process-level compiled-program sharing.  The callable a family jits
+# is fully determined by (cfg, tp, paged geometry, max_total, the
+# family's own statics): two engines with the same key — replica
+# fleets, supervised restarts, parity-test pairs — reuse ONE jitted
+# callable instead of recompiling identical programs.  Safe because
+# every program is pure (all state rides in the arguments) and
+# jax.jit retraces per input aval, so shape differences (n_slots,
+# prompt buckets) never alias.  The executables themselves live in
+# jax's own caches, so jax.clear_caches() still frees them; this dict
+# only pins the small wrapper objects.
+_SHARED_PROGRAMS: dict = {}
+
+
+def _shared_program(key, thunk):
+    fn = _SHARED_PROGRAMS.get(key)
+    if fn is None:
+        fn = _SHARED_PROGRAMS[key] = thunk()
+    return fn
 
 
 class _Inflight:
@@ -643,6 +763,9 @@ class ServingEngine:
         lora_parity: bool | str = "auto",
         tenancy=None,
         embedders=None,
+        paged: bool = False,
+        block_size: int | None = None,
+        paged_parity: bool | str = "auto",
     ):
         self.n_slots = n_slots
         self.max_total = int(min(max_total or cfg.max_len, cfg.max_len))
@@ -651,6 +774,11 @@ class ServingEngine:
         # fleets, restarts, tests — skip the cold-start probe
         # dispatches entirely. probes_run / probes_from_cache record
         # which probes actually dispatched this instance.
+        # DL4J_TPU_PROBE_CACHE supplies a default path for library
+        # construction sites that don't thread the kwarg (the CLI
+        # passes its own --probe-cache); an explicit kwarg wins.
+        if probe_cache is None:
+            probe_cache = os.environ.get("DL4J_TPU_PROBE_CACHE") or None
         self._probe_cache = (
             probe_cache if isinstance(probe_cache, ProbeCache)
             else ProbeCache(probe_cache) if probe_cache else None
@@ -765,7 +893,11 @@ class ServingEngine:
         # one-time weight cast (generate does this inside its jitted
         # program; hoisting it out of the per-step program keeps every
         # step from re-casting — same values, cast is deterministic)
-        self.params = jax.jit(cast_params)(params)
+        self._cfg_key = cfg.to_json()
+        self.params = _shared_program(
+            (self._cfg_key, self.tp, "cast_params"),
+            lambda: jax.jit(cast_params),
+        )(params)
         if self.lora_bank is not None and lora_parity is not True:
             ok = self._probe_verdict(
                 "lora_zero", self._probe_lora_zero,
@@ -784,11 +916,49 @@ class ServingEngine:
                 self.lora_bank = None
                 self.n_adapters = 0
 
-        self.pool = KVSlotPool(
-            cfg, n_slots, self.max_total,
-            sharding=(serving_tp_cache_sharding(self.tp_mesh, cfg)
-                      if self.tp_mesh is not None else None),
-        )
+        # block-paged KV: the pool becomes a shared store of fixed-size
+        # blocks with per-slot int32 block tables (vLLM-style), so
+        # long-prompt traffic allocates ceil((prompt+max_new)/bs)
+        # blocks instead of a full Tpad slab and cached prefixes are
+        # byte-SHARED by table aliasing. Behind the standing parity
+        # bar: paged_parity "auto" probes the paged step bitwise
+        # against the slab step once (verdict persisted via
+        # probe_cache, like tp_parity) and falls back to the slab
+        # layout on mismatch; True trusts the layout, False disables.
+        self._paged = False
+        self._block_size = int(block_size or 8)
+        if paged and paged_parity is not False:
+            tpad = jax.tree.leaves(jax.eval_shape(
+                lambda: self._init_caches(1, self.max_total)
+            ))[0].shape[3]
+            if tpad % self._block_size:
+                log_event(_log, "paged_disabled_bad_block_size",
+                          block_size=self._block_size, tpad=tpad)
+            else:
+                ok = True if paged_parity is True else self._probe_verdict(
+                    "paged_parity",
+                    lambda: self._probe_paged_parity(self._block_size),
+                    cfg=cfg, block_size=self._block_size,
+                    n_slots=n_slots, max_total=self.max_total,
+                    tpad=tpad, tp=self.tp,
+                )
+                if ok:
+                    self._paged = True
+                else:
+                    log_event(_log, "paged_parity_probe_failed",
+                              block_size=self._block_size)
+
+        pool_sharding = (serving_tp_cache_sharding(self.tp_mesh, cfg)
+                         if self.tp_mesh is not None else None)
+        if self._paged:
+            self.pool = PagedKVPool(
+                cfg, n_slots, self.max_total, sharding=pool_sharding,
+                block_size=self._block_size,
+            )
+        else:
+            self.pool = KVSlotPool(
+                cfg, n_slots, self.max_total, sharding=pool_sharding,
+            )
         # NOT `scheduler or ...`: RequestScheduler defines __len__, so
         # a caller's (normally empty) scheduler would be falsy and
         # silently swapped for a default one, dropping its knobs
@@ -813,6 +983,13 @@ class ServingEngine:
             mb *= 2
         self._max_bucket = mb
         self._min_bucket = min(8, mb)
+        # partial-hit rounding grain: block-aligned in paged mode so
+        # every partial hit is pure block aliasing (no sub-block copy),
+        # the bucket grain otherwise
+        self._hit_grain = (
+            max(self._min_bucket, self._block_size) if self._paged
+            else self._min_bucket
+        )
 
         # prefix cache: radix tree over a bounded segment region with
         # the pool's slab layout (see serving.prefix_cache). Partial
@@ -829,9 +1006,10 @@ class ServingEngine:
                 (prefix_cache_tokens if prefix_cache_tokens is not None
                  else n_slots * self.pool.tpad),
                 on_evict=self._on_prefix_evict,
-                # branch-point segments shorter than the bucket grain
-                # can never serve a hit (partial matches round down)
-                min_seg_len=self._min_bucket,
+                # branch-point segments shorter than the hit grain
+                # can never serve a hit (partial matches round down;
+                # block-aligned under paging)
+                min_seg_len=self._hit_grain,
             )
         self._register_gauges()
 
@@ -894,17 +1072,39 @@ class ServingEngine:
         # static donation audit checks that table against the traced
         # programs, so drift between intent and program shape fails CI.
         self._tpu = jax.devices()[0].platform == "tpu"
-        self._state_donate = self._donate("step")
+        self._state_donate = self._donate(
+            "paged_step" if self._paged else "step"
+        )
+        # every program below is shared process-wide through
+        # _shared_program keyed on this tuple + the family's own
+        # statics (platform is constant within a process, so the
+        # _donate() results are a function of the family name and
+        # need not be keyed)
+        self._prog_key = (
+            self._cfg_key, self.tp, self._paged, self._block_size,
+            self.max_total,
+        )
         # one compiled step program per horizon ACTUALLY used: just
         # {K} static, {1, K} with the adaptive horizon
         self._step_fns: dict[int, object] = {}
-        self._replay_fn = jax.jit(
-            build_replay_program(self._fwd1),
-            donate_argnums=self._donate("replay"),
+        self._replay_fn = _shared_program(
+            self._prog_key + ("replay",),
+            lambda: jax.jit(
+                build_replay_program(
+                    make_paged_fwd1(self._fwd1) if self._paged
+                    else self._fwd1
+                ),
+                donate_argnums=self._donate(
+                    "paged_replay" if self._paged else "replay"
+                ),
+            ),
         )
-        self._deact_fn = jax.jit(
-            build_deact_program(),
-            donate_argnums=self._donate("deactivate"),
+        self._deact_fn = _shared_program(
+            self._prog_key + ("deactivate",),
+            lambda: jax.jit(
+                build_deact_program(),
+                donate_argnums=self._donate("deactivate"),
+            ),
         )
         self._prefill_fns: dict[int, object] = {}
         self._chunk_fns: dict[int, object] = {}
@@ -916,6 +1116,16 @@ class ServingEngine:
         self._seg_fetch_fn = None
         self._logit_row_fn = None
         self._admit_donate = self._donate("prefill")
+        # paged program caches. The SLAB prefill/insert/chunk caches
+        # above stay live in paged mode too: the parity probes run the
+        # slab programs on scratch state, and the chunked partial-hit
+        # path computes suffix windows on batch-1 slab scratch in both
+        # modes.
+        self._paged_prefill_fns: dict[int, object] = {}
+        self._paged_insert_fn = None
+        self._paged_seg_fetch_fn = None
+        self._block_copy_fn = None
+        self._paged_admit_donate = self._donate("paged_prefill")
 
     def _register_gauges(self) -> None:
         """Live-state gauges on the metrics registry: scrapes read
@@ -944,6 +1154,24 @@ class ServingEngine:
             "Device bytes of the pooled KV cache (global logical bytes "
             "under TP; precomputed host metadata, no device sync).",
         ).set_function(lambda: self.pool.nbytes())
+        if self.pool.is_paged:
+            reg.gauge(
+                "serve_kv_blocks",
+                "Allocatable KV blocks in the paged pool (sentinel "
+                "excluded).",
+            ).set_function(lambda: self.pool.n_blocks - 1)
+            reg.gauge(
+                "serve_kv_blocks_free",
+                "KV blocks on the paged pool's free heap.",
+            ).set_function(lambda: self.pool.n_free_blocks)
+            reg.gauge(
+                "serve_kv_blocks_in_use",
+                "KV blocks held by slot tables or cached segments.",
+            ).set_function(lambda: self.pool.n_blocks_in_use)
+            reg.gauge(
+                "serve_kv_block_size",
+                "Rows per KV block (paged layout granule).",
+            ).set_function(lambda: self.pool.block_size)
         reg.gauge(
             "serve_tp_degree",
             "Tensor-parallel width the engine is serving at (1 = "
@@ -1017,12 +1245,18 @@ class ServingEngine:
         configured K and 1)."""
         fn = self._step_fns.get(horizon)
         if fn is None:
-            fn = jax.jit(
-                build_step_program(
-                    self._fwd1, horizon, self.temperature, self.top_k,
-                    self.approx_top_k,
+            fn = _shared_program(
+                self._prog_key + ("step", horizon, self.temperature,
+                                  self.top_k, self.approx_top_k),
+                lambda: jax.jit(
+                    build_step_program(
+                        make_paged_fwd1(self._fwd1) if self._paged
+                        else self._fwd1,
+                        horizon, self.temperature, self.top_k,
+                        self.approx_top_k,
+                    ),
+                    donate_argnums=self._state_donate,
                 ),
-                donate_argnums=self._state_donate,
             )
             self._step_fns[horizon] = fn
         return fn
@@ -1032,11 +1266,18 @@ class ServingEngine:
         :func:`build_prefill_program`)."""
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(
-                build_prefill_program(
-                    self._do_prefill, self._init_caches, self.max_total
+            # bucket only changes input shapes, so every bucket shares
+            # ONE callable (jit traces per aval under the hood; the
+            # per-bucket dict keys still express the compile surface)
+            fn = _shared_program(
+                self._prog_key + ("prefill",),
+                lambda: jax.jit(
+                    build_prefill_program(
+                        self._do_prefill, self._init_caches,
+                        self.max_total,
+                    ),
+                    donate_argnums=self._admit_donate,
                 ),
-                donate_argnums=self._admit_donate,
             )
             self._prefill_fns[bucket] = fn
         return fn
@@ -1046,7 +1287,10 @@ class ServingEngine:
         (see :func:`build_chunk_program`)."""
         fn = self._chunk_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(build_chunk_program(self._fwd_chunk))
+            fn = _shared_program(
+                self._prog_key + ("chunk",),
+                lambda: jax.jit(build_chunk_program(self._fwd_chunk)),
+            )
             self._chunk_fns[bucket] = fn
         return fn
 
@@ -1054,9 +1298,12 @@ class ServingEngine:
         """Jitted slab insert + state set (see
         :func:`build_insert_program`)."""
         if self._insert_fn is None:
-            self._insert_fn = jax.jit(
-                build_insert_program(),
-                donate_argnums=self._donate("insert"),
+            self._insert_fn = _shared_program(
+                self._prog_key + ("insert",),
+                lambda: jax.jit(
+                    build_insert_program(),
+                    donate_argnums=self._donate("insert"),
+                ),
             )
         return self._insert_fn
 
@@ -1065,9 +1312,12 @@ class ServingEngine:
         :func:`build_hit_insert_program`)."""
         if self._hit_insert_fn is None:
             # donates the pool state only — the region must survive
-            self._hit_insert_fn = jax.jit(
-                build_hit_insert_program(),
-                donate_argnums=self._donate("hit_insert"),
+            self._hit_insert_fn = _shared_program(
+                self._prog_key + ("hit_insert",),
+                lambda: jax.jit(
+                    build_hit_insert_program(),
+                    donate_argnums=self._donate("hit_insert"),
+                ),
             )
         return self._hit_insert_fn
 
@@ -1075,16 +1325,22 @@ class ServingEngine:
         """Jitted segment fetch (see
         :func:`build_seg_fetch_program`)."""
         if self._seg_fetch_fn is None:
-            self._seg_fetch_fn = jax.jit(build_seg_fetch_program())
+            self._seg_fetch_fn = _shared_program(
+                self._prog_key + ("seg_fetch",),
+                lambda: jax.jit(build_seg_fetch_program()),
+            )
         return self._seg_fetch_fn
 
     def _seg_store(self):
         """Jitted segment store (see
         :func:`build_seg_store_program`)."""
         if self._seg_store_fn is None:
-            self._seg_store_fn = jax.jit(
-                build_seg_store_program(),
-                donate_argnums=self._donate("seg_store"),
+            self._seg_store_fn = _shared_program(
+                self._prog_key + ("seg_store",),
+                lambda: jax.jit(
+                    build_seg_store_program(),
+                    donate_argnums=self._donate("seg_store"),
+                ),
             )
         return self._seg_store_fn
 
@@ -1092,20 +1348,80 @@ class ServingEngine:
         """Jitted (1, V) pending-logits row slice (see
         :func:`build_logit_row_program`)."""
         if self._logit_row_fn is None:
-            self._logit_row_fn = jax.jit(build_logit_row_program())
+            self._logit_row_fn = _shared_program(
+                self._prog_key + ("logit_row",),
+                lambda: jax.jit(build_logit_row_program()),
+            )
         return self._logit_row_fn
+
+    def _paged_prefill_fn(self, bucket: int):
+        """Jitted paged admission program for one prompt bucket (see
+        :func:`build_paged_prefill_program`)."""
+        fn = self._paged_prefill_fns.get(bucket)
+        if fn is None:
+            fn = _shared_program(
+                self._prog_key + ("paged_prefill",),
+                lambda: jax.jit(
+                    build_paged_prefill_program(
+                        self._do_prefill, self._init_caches,
+                        self.max_total,
+                    ),
+                    donate_argnums=self._paged_admit_donate,
+                ),
+            )
+            self._paged_prefill_fns[bucket] = fn
+        return fn
+
+    def _paged_insert(self):
+        """Jitted paged insert + state set (see
+        :func:`build_paged_insert_program`)."""
+        if self._paged_insert_fn is None:
+            self._paged_insert_fn = _shared_program(
+                self._prog_key + ("paged_insert",),
+                lambda: jax.jit(
+                    build_paged_insert_program(),
+                    donate_argnums=self._donate("paged_insert"),
+                ),
+            )
+        return self._paged_insert_fn
+
+    def _paged_seg_fetch(self):
+        """Jitted paged segment fetch (see
+        :func:`build_paged_seg_fetch_program`)."""
+        if self._paged_seg_fetch_fn is None:
+            self._paged_seg_fetch_fn = _shared_program(
+                self._prog_key + ("paged_seg_fetch",),
+                lambda: jax.jit(build_paged_seg_fetch_program()),
+            )
+        return self._paged_seg_fetch_fn
+
+    def _block_copy(self):
+        """Jitted single-block copy (see
+        :func:`build_block_copy_program`)."""
+        if self._block_copy_fn is None:
+            self._block_copy_fn = _shared_program(
+                self._prog_key + ("block_copy",),
+                lambda: jax.jit(
+                    build_block_copy_program(),
+                    donate_argnums=self._donate("block_copy"),
+                ),
+            )
+        return self._block_copy_fn
 
     def _batch_prefill_fn(self, bucket: int, nb: int):
         """Jitted BATCHED admission prefill (see
         :func:`build_batch_prefill_program`)."""
         fn = self._batch_prefill_fns.get((bucket, nb))
         if fn is None:
-            fn = jax.jit(
-                build_batch_prefill_program(
-                    self._do_prefill, self._init_caches,
-                    self.max_total, nb,
+            fn = _shared_program(
+                self._prog_key + ("batch_prefill", nb),
+                lambda: jax.jit(
+                    build_batch_prefill_program(
+                        self._do_prefill, self._init_caches,
+                        self.max_total, nb,
+                    ),
+                    donate_argnums=self._admit_donate,
                 ),
-                donate_argnums=self._admit_donate,
             )
             self._batch_prefill_fns[(bucket, nb)] = fn
         return fn
@@ -1115,9 +1431,12 @@ class ServingEngine:
         :func:`build_batch_hit_program`)."""
         fn = self._batch_hit_fns.get((bucket, nb))
         if fn is None:
-            fn = jax.jit(
-                build_batch_hit_program(self._fwd_chunk, nb),
-                donate_argnums=self._admit_donate,
+            fn = _shared_program(
+                self._prog_key + ("batch_hit", nb),
+                lambda: jax.jit(
+                    build_batch_hit_program(self._fwd_chunk, nb),
+                    donate_argnums=self._admit_donate,
+                ),
             )
             self._batch_hit_fns[(bucket, nb)] = fn
         return fn
@@ -1394,22 +1713,28 @@ class ServingEngine:
 
     def _prefill_into_state(self, state, seq: np.ndarray, slot: int,
                             budget: int, eos_tok: int,
-                            adapter: int = 0):
+                            adapter: int = 0, paged: bool = False):
         """Land ``seq`` in ``slot`` of a pool-shaped ``state`` tuple
         through the bucketed prefill path and return the new state
         (pure w.r.t. engine attributes — the parity probes run it on
         scratch state). Dispatches O(1) programs for bucket-sized
         sequences and O(len/bucket) on the chunked long-prompt path.
         ``adapter`` selects the LoRA bank row (traced data, so every
-        adapter shares the bucket's one compiled program)."""
+        adapter shares the bucket's one compiled program). With
+        ``paged`` the state's caches are the {"blocks", "tables"} dict
+        and the two landing dispatches switch to the paged programs —
+        everything else (bucketing, chunk windows, the batch-1 scratch
+        compute) is byte-for-byte the slab path, which is what keeps
+        the slab parity probes valid in a paged engine."""
         n = int(len(seq))
         ad = jnp.asarray([adapter], jnp.int32)
+        insert = self._paged_insert() if paged else self._insert()
         if n == 0:
             # empty prompt: decode starts from uniform logits over a
             # zeroed slab, as the unbucketed prefill did
             tmp = self._init_caches(1, self.max_total)
             lg = jnp.zeros((1, self.cfg.vocab_size), jnp.float32)
-            return self._insert()(
+            return insert(
                 *state, tmp, lg, jnp.int32(slot), jnp.int32(0),
                 jnp.int32(budget), jnp.int32(eos_tok),
             )
@@ -1418,7 +1743,8 @@ class ServingEngine:
             pad = np.zeros((1, b), np.int32)
             pad[0, :n] = seq
             self.prefill_dispatches += 1
-            return self._prefill_fn(b)(
+            pf = self._paged_prefill_fn(b) if paged else self._prefill_fn(b)
+            return pf(
                 *state, self.params, jnp.asarray(pad), jnp.int32(n - 1),
                 jnp.int32(slot), jnp.int32(n), jnp.int32(budget),
                 jnp.int32(eos_tok), ad,
@@ -1437,18 +1763,49 @@ class ServingEngine:
                 jnp.int32(ln - 1), ad,
             )
             self.prefill_dispatches += 1
-        return self._insert()(
+        return insert(
             *state, tmp, lg, jnp.int32(slot), jnp.int32(n),
             jnp.int32(budget), jnp.int32(eos_tok),
         )
 
+    def _caches_in(self):
+        """The caches operand for the next dispatch. Paged mode
+        rebuilds the {"blocks", "tables"} dict with a FRESH device
+        mirror of the host block tables EVERY call — a stale mirror
+        from before a release/re-admit would scatter a dead slot's
+        decode rows into blocks the pool has since handed to someone
+        else, so never cache this across pool mutations."""
+        if self._paged:
+            return {
+                "blocks": self.pool.caches,
+                "tables": jnp.asarray(self.pool.tables()),
+            }
+        return self.pool.caches
+
+    def _caches_out(self, caches) -> None:
+        """Re-own the caches a dispatch returned (the table mirror is
+        discarded — the host tables are the source of truth)."""
+        self.pool.caches = caches["blocks"] if self._paged else caches
+
     def _state(self):
-        return (self.pool.caches, self._logits, self._dpos,
+        return (self._caches_in(), self._logits, self._dpos,
                 self._dactive, self._dbudget, self._deos)
 
     def _set_state(self, out) -> None:
-        (self.pool.caches, self._logits, self._dpos, self._dactive,
+        (caches, self._logits, self._dpos, self._dactive,
          self._dbudget, self._deos) = out
+        self._caches_out(caches)
+
+    def _paged_ensure_blocks(self, slot: int, n_tokens: int) -> None:
+        """Grow ``slot``'s block coverage to ``n_tokens`` rows with
+        fresh private blocks (no-op when already covered — the aliased
+        prefix-hit entries stay untouched). Clamped to the slab row
+        bound: rows past Tpad cannot exist in either layout."""
+        n_tokens = min(int(n_tokens), self.pool.tpad)
+        need = self.pool.blocks_needed(n_tokens)
+        have = int(np.count_nonzero(self.pool.table(slot)))
+        if need > have:
+            self.pool.alloc_slot_blocks(slot, n_tokens, start=have)
 
     def _prefill_seq_into_slot(self, seq: np.ndarray, slot: int,
                                budget: int, eos_tok: int,
@@ -1457,8 +1814,15 @@ class ServingEngine:
         through the bucketed prefill path and set the slot's device
         state: position len(seq), active, ``budget`` tokens
         remaining."""
+        if self._paged:
+            # cover every row the slot can ever write BEFORE building
+            # the state tuple, so the fresh table mirror includes the
+            # allocation (rows past coverage scatter to the sentinel
+            # and vanish)
+            self._paged_ensure_blocks(slot, len(seq) + budget)
         self._set_state(self._prefill_into_state(
-            self._state(), seq, slot, budget, eos_tok, adapter
+            self._state(), seq, slot, budget, eos_tok, adapter,
+            paged=self._paged,
         ))
 
     def _check_prefill_faults(self, req: Request) -> bool:
@@ -1785,6 +2149,95 @@ class ServingEngine:
             return False
         return all(np.array_equal(a, b) for a, b in zip(ref, lz))
 
+    def _probe_paged_parity(self, block_size: int) -> bool:
+        """One-time probe gating the paged KV layout — the block-table
+        mirror of ``tp_parity``: does the paged step (block gather,
+        IDENTICAL fwd1 compute, block scatter) reproduce, bitwise, the
+        slab step's logits on scratch state? Both legs run batch-2 over
+        the same prefilled rows, with the paged tables SHUFFLED (blocks
+        land scattered through the pool, as after churn) and one block
+        ALIASED between the rows (the shared-prefix shape — both rows
+        write identical bytes into it, since their inputs are
+        identical). Bitwise-equal logits at every step make greedy AND
+        sampled streams identical (sampling is a pure function of
+        logits, slot key and position). Runs before the pool exists, on
+        self-built scratch blocks."""
+        total = int(min(self.max_total, 32))
+        n = min(8, total - 4)
+        if n < 1:
+            return False
+        seq = ((1 + np.arange(n)) % self.cfg.vocab_size).astype(np.int32)
+        prompt = jnp.asarray(seq[None])
+        try:
+            shapes = jax.eval_shape(
+                lambda: self._init_caches(1, total)
+            )
+            tpad = jax.tree.leaves(shapes)[0].shape[3]
+            if tpad % block_size:
+                return False
+            bps = tpad // block_size
+            tmp, lg = jax.jit(self._do_prefill)(  # lint: retrace-ok one-shot parity probe
+                self.params, self._init_caches(1, total), prompt
+            )
+            # slab leg: the prefilled slab landed in both rows of a
+            # 2-slot pool
+            slab = self._init_caches(2, total)
+            place = jax.jit(  # lint: retrace-ok one-shot parity probe
+                lambda c, t, s: jax.tree.map(
+                    lambda cc, tt: lax.dynamic_update_slice(
+                        cc, tt, (0, 0, s, 0, 0)
+                    ),
+                    c, t,
+                )
+            )
+            for s in (0, 1):
+                slab = place(slab, tmp, jnp.int32(s))
+            # paged leg: the same rows scattered through shuffled
+            # tables, rows 0 and 1 aliasing one shared block
+            perm = np.random.default_rng(0).permutation(2 * bps) + 1
+            tables = perm.reshape(2, bps).astype(np.int32)
+            tables[1, 0] = tables[0, 0]
+            blocks = jax.tree.map(
+                lambda sh: jnp.zeros(
+                    (sh.shape[0], sh.shape[1], 2 * bps + 1,
+                     block_size, sh.shape[4]),
+                    sh.dtype,
+                ),
+                shapes,
+            )
+            dtab = jnp.asarray(tables)
+            scatter = jax.jit(paged_slot_scatter)  # lint: retrace-ok one-shot parity probe
+            for s in (0, 1):
+                blocks = scatter(blocks, dtab[s], tmp)
+            pcaches = {"blocks": blocks, "tables": dtab}
+
+            sstep = jax.jit(  # lint: retrace-ok one-shot parity probe
+                lambda c, l, p: self._fwd1(
+                    self.params, c,
+                    jnp.argmax(l, axis=-1).astype(jnp.int32), p,
+                )
+            )
+            pfwd1 = make_paged_fwd1(self._fwd1)
+            pstep = jax.jit(  # lint: retrace-ok one-shot parity probe
+                lambda c, l, p: pfwd1(
+                    self.params, c,
+                    jnp.argmax(l, axis=-1).astype(jnp.int32), p,
+                )
+            )
+            lg2 = jnp.concatenate([lg, lg], axis=0)
+            slg, plg = lg2, lg2
+            pos = jnp.full((2,), n, jnp.int32)
+            for _ in range(3):
+                slg, slab = sstep(slab, slg, pos)
+                plg, pcaches = pstep(pcaches, plg, pos)
+                pos = pos + 1
+                if not np.array_equal(np.asarray(slg), np.asarray(plg)):
+                    return False
+            return True
+        except Exception as e:  # pragma: no cover - backend-specific
+            log_event(_log, "paged_parity_probe_error", error=repr(e))
+            return False
+
     def _prefix_reuse_ok(self) -> bool:
         if self.prefix_cache is None:
             return False
@@ -1802,6 +2255,11 @@ class ServingEngine:
         return self._prefix_ok_memo
 
     def _batch_admission_ok(self) -> bool:
+        if self._paged:
+            # the batched admission programs are slab-landing (whole
+            # groups dynamic-update into pool slabs); paged admissions
+            # go serial through the paged prefill/insert programs
+            return False
         if self.batch_admission is True:
             return True
         if self.batch_admission is False:
@@ -1845,7 +2303,7 @@ class ServingEngine:
             pl.kind, pl.seg, pl.matched = "full", seg, n
         else:
             L = min(m, n - 1)
-            L -= L % self._min_bucket
+            L -= L % self._hit_grain
             if L <= 0:
                 self.metrics.record_prefix_lookup("miss", 0)
                 return
@@ -1860,16 +2318,55 @@ class ServingEngine:
             kind=pl.kind, cached_tokens=pl.matched, prompt_len=n,
         )
 
+    def _paged_seg_tmp(self, seg):
+        """Gather a cached segment's blocks into a batch-1 scratch slab
+        (sentinel-padded table row, so rows past the segment's block
+        span come back zero). The chunked suffix programs and the paged
+        insert consume it exactly like a slab-mode segment fetch."""
+        row = np.zeros((self.pool.blocks_per_slot,), np.int32)
+        row[:len(seg.block_ids)] = seg.block_ids
+        return self._paged_seg_fetch()(
+            self.pool.caches, jnp.asarray(row)
+        )
+
+    def _alias_hit_blocks(self, pl: _AdmitPlan, covered: int) -> None:
+        """Land a prefix hit's cached rows by table aliasing: share the
+        segment's FULL blocks over rows [0, covered) into the slot
+        (refcount bump, zero device work), then cover the rest of the
+        slot's writable range with fresh private blocks. The segment's
+        copied tail block (when its length is not block-aligned) is
+        never aliased — rows the slot itself writes, hit-suffix or
+        decode, must land in private blocks."""
+        full = covered // self.pool.block_size
+        if full:
+            self.pool.alias_into_slot(pl.slot, pl.seg.block_ids[:full])
+        self._paged_ensure_blocks(
+            pl.slot, len(pl.req.prompt) + pl.req.max_new
+        )
+
     def _admit_full_hit(self, pl: _AdmitPlan) -> None:
         """Admission by pure device copy: segment slab + stored logits.
         Dispatches ZERO prefill programs for the cached portion — which
-        is all of it."""
+        is all of it. Paged mode goes further: the segment's full
+        blocks are byte-SHARED into the slot's table (aliasing, no
+        copy); one gather + one insert land the tail rows and re-zero
+        the fresh private blocks."""
         req = pl.req
+        n = len(req.prompt)
         eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
+        if self._paged:
+            self._alias_hit_blocks(pl, n)
+            tmp = self._paged_seg_tmp(pl.seg)
+            self._set_state(self._paged_insert()(
+                *self._state(), tmp, pl.seg.logits, jnp.int32(pl.slot),
+                jnp.int32(n), jnp.int32(req.max_new),
+                jnp.int32(eos_tok),
+            ))
+            return
         self._set_state(self._hit_insert()(
             *self._state(), self.prefix_cache.region, pl.seg.logits,
             jnp.int32(pl.seg.slot), jnp.int32(pl.slot),
-            jnp.int32(len(req.prompt)), jnp.int32(req.max_new),
+            jnp.int32(n), jnp.int32(req.max_new),
             jnp.int32(eos_tok),
         ))
 
@@ -1877,13 +2374,21 @@ class ServingEngine:
         """Serial partial-hit assembly: fetch the segment slab as the
         scratch cache, chunk-compute rows [matched, n) through the same
         bucket programs the long-prompt path uses, then one slab
-        insert. Only the uncached suffix costs prefill dispatches."""
+        insert. Only the uncached suffix costs prefill dispatches. In
+        paged mode the matched rows additionally land in the slot by
+        block ALIASING (the hit grain is block-aligned, so the matched
+        range is whole shared blocks) and the insert scatters through
+        the slot's table."""
         req = pl.req
         seq, n, L = req.prompt, len(req.prompt), pl.matched
         eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
-        tmp = self._seg_fetch()(
-            self.prefix_cache.region, jnp.int32(pl.seg.slot)
-        )
+        if self._paged:
+            self._alias_hit_blocks(pl, L)
+            tmp = self._paged_seg_tmp(pl.seg)
+        else:
+            tmp = self._seg_fetch()(
+                self.prefix_cache.region, jnp.int32(pl.seg.slot)
+            )
         lg = None
         for t0, ln, b in self._chunk_schedule(n, start=L):
             pad = np.zeros((1, b), np.int32)
@@ -1894,7 +2399,8 @@ class ServingEngine:
                 jnp.asarray([req.adapter], jnp.int32),
             )
             self.prefill_dispatches += 1
-        self._set_state(self._insert()(
+        insert = self._paged_insert() if self._paged else self._insert()
+        self._set_state(insert(
             *self._state(), tmp, lg, jnp.int32(pl.slot), jnp.int32(n),
             jnp.int32(req.max_new), jnp.int32(eos_tok),
         ))
@@ -2029,17 +2535,32 @@ class ServingEngine:
         diverging there — the system-prompt sharing signal); it gets
         the same slab copy but NO logits row (no request ended at that
         length, so it only ever serves partial hits). The creating
-        request pins every segment until retirement."""
+        request pins every segment until retirement.
+
+        Paged storage inverts the copy direction of the slab region:
+        instead of copying the slot's slab OUT, the segment takes
+        cache-owned REFERENCES on the slot's own full blocks (incref —
+        the slot never rewrites rows below its prompt length) plus one
+        privately copied tail block when the length is not
+        block-aligned (the slot keeps writing that block's remaining
+        rows). One block copy at most, usually zero device work."""
         cache = self.prefix_cache
         n = len(pl.req.prompt)
         if (cache is None or pl.kind == "full" or pl.req.adapter != 0
                 or n < self._min_bucket or not self._prefix_reuse_ok()):
             return
         for seg in cache.insert(pl.req.prompt):
-            cache.region = self._seg_store()(
-                cache.region, self.pool.caches, jnp.int32(seg.slot),
-                jnp.int32(pl.slot),
-            )
+            if self._paged:
+                if not self._paged_store_segment(seg, pl.slot):
+                    # tail-block allocation lost to admission pressure:
+                    # un-cache rather than leave an unbacked segment
+                    cache.drop(seg)
+                    continue
+            else:
+                cache.region = self._seg_store()(
+                    cache.region, self.pool.caches, jnp.int32(seg.slot),
+                    jnp.int32(pl.slot),
+                )
             if seg.length == n:
                 seg.logits = self._logit_row()(
                     self._logits, jnp.int32(pl.slot))
@@ -2049,6 +2570,32 @@ class ServingEngine:
                 ENGINE_TRACK, "prefix_insert", req_id=pl.req.id,
                 length=seg.length,
             )
+
+    def _paged_store_segment(self, seg, slot: int) -> bool:
+        """Back a new segment with block references off donor ``slot``:
+        incref the donor's full blocks (aliased, zero device work —
+        the donor only ever writes rows >= seg.length, which live in
+        later blocks) and COPY the partial tail block, if any, into a
+        cache-private block (the donor keeps writing that block's
+        remaining rows). Returns False — no references taken — when
+        the tail block cannot be allocated."""
+        bs = self.pool.block_size
+        row = self.pool.table(slot)
+        full = seg.length // bs
+        tail = seg.length % bs
+        try:
+            tail_ids = self.pool.alloc_blocks(1) if tail else []
+        except RuntimeError:
+            return False
+        ids = [int(b) for b in row[:full]]
+        self.pool.incref(ids)
+        if tail:
+            self.pool.caches = self._block_copy()(
+                self.pool.caches, jnp.int32(int(row[full])),
+                jnp.int32(tail_ids[0]),
+            )
+        seg.block_ids = ids + tail_ids
+        return True
 
     # lint: hot-path
     def _admit(self, now: float) -> None:
@@ -2077,11 +2624,28 @@ class ServingEngine:
                     tid = st.req.tenant_id
                     used[tid] = used.get(tid, 0) + 1
 
+        # paged: blocks this admission round has already promised to
+        # plans not yet executed — two plans must not both pass the
+        # free-heap check against the same blocks. Conservative (a
+        # prefix hit will alias part of its need), so execution-time
+        # allocation can never fail.
+        reserved = [0]
+
         def admissible(r):
             if r.kind != "generate":
                 return True  # embeddings are served host-side, slotless
             if self.pool.n_free == 0:
                 return False
+            if self._paged:
+                need = self.pool.blocks_needed(
+                    len(r.prompt) + r.max_new
+                )
+                while need + reserved[0] > self.pool.n_free_blocks:
+                    # hand cached blocks back to the free heap before
+                    # declining — live traffic outranks cached prefixes
+                    if (self.prefix_cache is None
+                            or not self.prefix_cache.reclaim()):
+                        return False
             if self.tenancy is not None:
                 t = self.tenancy.get(r.tenant_id)
                 if (t is not None and t.max_slots is not None
@@ -2108,6 +2672,10 @@ class ServingEngine:
                     continue
                 plans.append(_AdmitPlan(req, self.pool.acquire()))
                 used[req.tenant_id] = used.get(req.tenant_id, 0) + 1
+                if self._paged:
+                    reserved[0] += self.pool.blocks_needed(
+                        len(req.prompt) + req.max_new
+                    )
                 # prefix affinity only helps adapter-0 traffic (nonzero
                 # adapters never reuse cached segments)
                 hint = req.prompt if req.adapter == 0 else None
@@ -2244,13 +2812,18 @@ class ServingEngine:
             try:
                 if self.faults is not None:
                     self.faults.check("step")
-                (self.pool.caches, self._logits, self._dpos,
+                # _caches_in INSIDE the retry loop: a quarantining
+                # retire below releases the slot and rewrites its table
+                # row, so the paged table mirror must be rebuilt before
+                # every (re)dispatch
+                (caches, self._logits, self._dpos,
                  self._dactive, self._dbudget, toks) = step_fn(
-                    self.params, self.pool.caches, self._logits,
+                    self.params, self._caches_in(), self._logits,
                     self._dpos, self._dactive, self._dbudget,
                     self._deos, jnp.asarray(keys_host),
                     jnp.asarray(ad_host),
                 )
+                self._caches_out(caches)
                 break
             except TransientFault as e:
                 self.metrics.record_retry()
@@ -2459,7 +3032,14 @@ class ServingEngine:
         la = np.asarray(self._logits[0])
         self.pool.reinit()
         self._reset_device_state()
-        self._prefill_seq_into_slot(seq[:k], 0, budget=1, eos_tok=_NO_EOS)
+        # budget length-k, not 1: in paged mode the prefill's block
+        # coverage is len(seq)+budget, and the teacher-forced rows
+        # [k, length) must land in allocated blocks (rows past coverage
+        # scatter to the sentinel and vanish). Budget never feeds the
+        # compared logits, so the slab verdict is unchanged.
+        self._prefill_seq_into_slot(
+            seq[:k], 0, budget=max(1, length - k), eos_tok=_NO_EOS
+        )
         pos = np.zeros((self.n_slots,), np.int32)
         replaying = np.zeros((self.n_slots,), bool)
         replaying[0] = True
@@ -2467,12 +3047,13 @@ class ServingEngine:
             toks = np.zeros((self.n_slots,), np.int32)
             toks[0] = seq[j]
             pos[0] = j
-            self.pool.caches, self._logits = self._replay_fn(
-                self.params, self.pool.caches, self._logits,
+            caches, self._logits = self._replay_fn(
+                self.params, self._caches_in(), self._logits,
                 jnp.asarray(toks), jnp.asarray(pos.copy()),
                 jnp.asarray(replaying),
                 jnp.zeros((self.n_slots,), jnp.int32),
             )
+            self._caches_out(caches)
         lb = np.asarray(self._logits[0])
         self.pool.reinit()
         self._reset_device_state()
@@ -2488,6 +3069,7 @@ class ServingEngine:
                 "chunked_replay", self._probe_chunked_parity,
                 n_slots=self.n_slots, max_total=self.max_total,
                 max_bucket=self._max_bucket, tp=self.tp,
+                paged=self._paged,
             )
         return self._chunked_ok
 
@@ -2574,12 +3156,13 @@ class ServingEngine:
             # pos must be snapshotted: jnp.asarray can zero-copy alias
             # a numpy buffer on CPU and dispatch is async, so mutating
             # pos below would race the in-flight replay step
-            self.pool.caches, self._logits = self._replay_fn(
-                self.params, self.pool.caches, self._logits,
+            caches, self._logits = self._replay_fn(
+                self.params, self._caches_in(), self._logits,
                 jnp.asarray(toks), jnp.asarray(pos.copy()),
                 jnp.asarray(replaying),
                 jnp.asarray(self._slot_adapters.copy()),
             )
+            self._caches_out(caches)
             for slot, st in live:
                 if j < len(st.tokens):
                     pos[slot] += 1

@@ -53,10 +53,17 @@ class Segment:
     """One cached prefix: ``length`` tokens of KV in region slot
     ``slot``, plus the (1, V) last-row logits captured at insert time —
     a FULL hit replays those logits directly, so a fully-cached
-    admission dispatches zero prefill programs."""
+    admission dispatches zero prefill programs.
+
+    Over a :class:`~deeplearning4j_tpu.serving.cache_pool.PagedKVPool`
+    the storage is ``block_ids`` instead of a region slot: the pool
+    block ids (cache-owned references) covering the prefix rows, mostly
+    aliased straight off the donor slot's table plus at most one
+    privately copied tail block. ``slot`` is then just a monotonic
+    identity used for deterministic eviction tie-breaks."""
 
     __slots__ = ("slot", "length", "node", "refs", "last_use", "hits",
-                 "logits", "alive")
+                 "logits", "alive", "block_ids")
 
     def __init__(self, slot: int, length: int, node: "_Node"):
         self.slot = slot
@@ -67,6 +74,7 @@ class Segment:
         self.hits = 0          # lifetime lookup hits (eviction weighting)
         self.logits = None     # device (1, V) row, set by the engine
         self.alive = True      # False once evicted (guards stale unpins)
+        self.block_ids = None  # paged mode: pool block ids, engine-set
 
 
 class _Node:
@@ -105,14 +113,34 @@ class PrefixCache:
                  on_evict: Callable[[Segment], None] | None = None,
                  min_seg_len: int = 1, hit_weight: float = 4.0):
         self.tpad = pool.tpad
-        self.n_region_slots = max(1, int(capacity_tokens) // self.tpad)
-        self.capacity_tokens = self.n_region_slots * self.tpad
-        self._alloc_region = lambda: pool.alloc_region(self.n_region_slots)
-        self.region = self._alloc_region()
-        # region byte size is fixed for the cache's lifetime: take it
-        # from the pool's host metadata so metrics scrapes never walk
-        # the live device pytree (see KVSlotPool.region_nbytes)
-        self._nbytes = pool.region_nbytes(self.n_region_slots)
+        self.paged = bool(getattr(pool, "is_paged", False))
+        if self.paged:
+            # Paged mode: no region at all. Segments live as
+            # refcounted block lists INSIDE the pool's shared block
+            # store (mostly aliases of the donor slot's blocks), so the
+            # capacity budget bounds how many blocks the cache may keep
+            # referenced, not a second allocation.
+            self._pool = pool
+            self.n_region_slots = 0
+            self.capacity_blocks = max(
+                1, int(capacity_tokens) // pool.block_size
+            )
+            self.capacity_tokens = self.capacity_blocks * pool.block_size
+            self.region = None
+            self._nbytes = 0
+            self._next_id = 0  # monotonic Segment.slot (tie-breaks)
+        else:
+            self._pool = pool
+            self.n_region_slots = max(1, int(capacity_tokens) // self.tpad)
+            self.capacity_tokens = self.n_region_slots * self.tpad
+            self._alloc_region = (
+                lambda: pool.alloc_region(self.n_region_slots)
+            )
+            self.region = self._alloc_region()
+            # region byte size is fixed for the cache's lifetime: take
+            # it from the pool's host metadata so metrics scrapes never
+            # walk the live device pytree (see KVSlotPool.region_nbytes)
+            self._nbytes = pool.region_nbytes(self.n_region_slots)
         self.on_evict = on_evict
         self.min_seg_len = max(1, int(min_seg_len))  # branch-seg floor
         # eviction score = last_use + hit_weight * hits: each lifetime
@@ -143,10 +171,24 @@ class PrefixCache:
     def n_pinned(self) -> int:
         return sum(1 for s in self._segments if s.refs > 0)
 
+    @property
+    def blocks_cached(self) -> int:
+        """Paged mode: pool blocks the live segments logically span
+        (``ceil(length/block_size)`` each — shared aliases counted once
+        per segment, matching the capacity budget's bookkeeping)."""
+        if not self.paged:
+            return 0
+        return sum(
+            self._pool.blocks_needed(s.length) for s in self._segments
+        )
+
     def nbytes(self) -> int:
-        """Device bytes of the segment region (global logical bytes
-        under TP). Precomputed host metadata — scrapes never touch the
-        live device arrays."""
+        """Device bytes the cache accounts for (global logical bytes
+        under TP): the fixed segment region in slab mode, or the live
+        segments' block span in paged mode. Host metadata either way —
+        scrapes never touch the live device arrays."""
+        if self.paged:
+            return self.blocks_cached * self._pool.block_nbytes()
         return self._nbytes
 
     def stats(self) -> dict:
@@ -280,12 +322,26 @@ class PrefixCache:
         seg = Segment(-1, length, node)
         seg.refs = 1
         node.segment = seg
-        slot = self._claim_slot()
-        if slot is None:
-            node.segment = None
-            self.n_insert_declined += 1
-            return None
-        seg.slot = slot
+        if self.paged:
+            # Budget in blocks, not region slots: evict unpinned
+            # segments until this one's block span fits, declining when
+            # everything left is pinned (same bounded-by-construction
+            # contract as the slab region).
+            need = self._pool.blocks_needed(length)
+            while self.blocks_cached + need > self.capacity_blocks:
+                if not self._evict_one():
+                    node.segment = None
+                    self.n_insert_declined += 1
+                    return None
+            self._next_id += 1
+            seg.slot = self._next_id
+        else:
+            slot = self._claim_slot()
+            if slot is None:
+                node.segment = None
+                self.n_insert_declined += 1
+                return None
+            seg.slot = slot
         self._tick += 1
         seg.last_use = self._tick
         self._segments.add(seg)
@@ -338,12 +394,31 @@ class PrefixCache:
             self.on_evict(victim)
         return True
 
+    def drop(self, seg: Segment) -> None:
+        """Abort an insert: the engine failed to back ``seg`` with
+        device rows (paged mode — the tail-block allocation lost a race
+        with admission pressure), so remove it before any lookup can
+        hit unbacked storage. Safe no-op on a segment already gone."""
+        if seg.alive:
+            self._drop(seg)
+
+    def reclaim(self) -> bool:
+        """Evict one unpinned segment on demand, returning whether one
+        was dropped. Paged admission uses this to hand cached blocks
+        back to the pool's free heap when a fresh request doesn't fit."""
+        return self._evict_one()
+
     def _drop(self, seg: Segment) -> None:
         seg.alive = False
         seg.logits = None
         seg.node.segment = None
         self._segments.discard(seg)
-        heapq.heappush(self._free, seg.slot)
+        if self.paged:
+            if seg.block_ids:
+                self._pool.decref(seg.block_ids)
+            seg.block_ids = None
+        else:
+            heapq.heappush(self._free, seg.slot)
         self._prune(seg.node)
 
     def _prune(self, node: _Node) -> None:
@@ -369,12 +444,19 @@ class PrefixCache:
         every segment AND every pin (the engine clears its per-slot
         segment refs in the same breath). Replay then misses on every
         lookup — the same code path as a cold start, so recovered
-        streams stay byte-identical."""
-        self.region = self._alloc_region()
+        streams stay byte-identical.
+
+        Paged ordering contract: the engine calls ``pool.reinit()``
+        FIRST (it resets every refcount and rebuilds the block free
+        heap wholesale), so dropping segments here must NOT decref
+        their block ids — the counts they referenced no longer exist."""
+        if not self.paged:
+            self.region = self._alloc_region()
         for seg in list(self._segments):
             seg.alive = False
             seg.logits = None
             seg.refs = 0
+            seg.block_ids = None
         self._root = _Node((), None)
         self._free = list(range(self.n_region_slots))
         self._segments = set()

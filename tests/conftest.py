@@ -12,8 +12,22 @@ time in conftest.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# One parity-probe verdict file for the whole session: engine
+# construction probes (chunked_replay, prefix_reuse, batch_admission,
+# lora_zero, tp_parity, paged_parity) are deterministic per
+# (cfg, backend, geometry), and the serving suites construct hundreds
+# of engines — without this every one re-dispatches its probes.
+# Tests that assert probe behaviour pass an explicit probe_cache=,
+# which always wins over this default.
+os.environ.setdefault(
+    "DL4J_TPU_PROBE_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="dl4j-test-probes-"),
+                 "probes.json"),
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -61,3 +75,17 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs
+
+
+# Drop jax's in-process caches (jit/pjit executables, lowering caches)
+# at every module boundary.  The serving suites construct hundreds of
+# short-lived engines, each jitting its own program set; the dead
+# executables pile up in process-global caches and the late modules of
+# a full run degrade to ~2-3x their standalone wall-clock (measured on
+# a 1-core runner: tail files 307s standalone vs ~600s+ in-run).
+# Modules do not share compiled programs with each other (every engine
+# jits fresh closures), so clearing between modules costs nothing.
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    yield
+    jax.clear_caches()

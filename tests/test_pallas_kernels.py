@@ -233,3 +233,116 @@ def test_flash_attention_noncausal_unchanged():
     out = flash_attention(q, k, v, block_q=64, block_k=64)
     ref = attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def _scatter_slab_to_blocks(slab, tables, block_size, n_blocks):
+    """Pack a contiguous (nl, 2, B, T, HK) slab into a block pool per
+    the given (B, T//bs) int32 table — the layout the paged serving
+    pool maintains incrementally (block id 0 = zero sentinel)."""
+    nl, two, b, t, hk = slab.shape
+    blocks = np.zeros((nl, two, n_blocks, block_size, hk), slab.dtype)
+    for i in range(b):
+        for j in range(t // block_size):
+            blocks[:, :, tables[i, j]] = (
+                slab[:, :, i, j * block_size:(j + 1) * block_size]
+            )
+    return blocks
+
+
+def test_flash_decode_paged_bitwise_matches_slab_kernel():
+    """The paged kernel (scalar-prefetch block tables, block-by-block
+    HBM gather) is BITWISE the slab kernel at block_t=block_size over
+    the gathered cache — same tile partitioning, same accumulation
+    order. Tables are shuffled and one block is aliased across rows,
+    so the lookup path really is exercised."""
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        flash_decode_attention,
+        flash_decode_attention_paged,
+    )
+
+    rng = np.random.default_rng(17)
+    b, g, n_kv, t, bs, layer = 2, 2, 2, 32, 8, 1
+    hk = n_kv * 16
+    bps = t // bs
+    q = jnp.asarray(rng.normal(size=(b, g, hk)).astype(np.float32))
+    slab = rng.normal(size=(2, 2, b, t, hk)).astype(np.float32)
+    # shuffled 1-based ids; alias row 1's first block to row 0's (the
+    # prefix-sharing case) AFTER building the slab view accordingly
+    tables = (rng.permutation(b * bps) + 1).reshape(b, bps).astype(np.int32)
+    tables[1, 0] = tables[0, 0]
+    slab[:, :, 1, :bs] = slab[:, :, 0, :bs]
+    blocks = _scatter_slab_to_blocks(slab, tables, bs, b * bps + 1)
+    pos = jnp.asarray(np.array([31, 13], np.int32))
+    out_paged = flash_decode_attention_paged(
+        q, jnp.asarray(blocks), jnp.asarray(tables), pos, n_kv,
+        layer=layer, interpret=True,
+    )
+    out_slab = flash_decode_attention(
+        q, jnp.asarray(slab), pos, n_kv, layer=layer, block_t=bs,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(out_paged),
+                                  np.asarray(out_slab))
+
+
+def test_flash_decode_paged_int8_bitwise_matches_slab_int8():
+    """int8 paged mode: per-row dequant scales ride in their own block
+    pool (same tables) and the fused dequant is bitwise the slab int8
+    kernel's — the HBM stream stays int8 bytes + table ints."""
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        flash_decode_attention,
+        flash_decode_attention_paged,
+    )
+
+    rng = np.random.default_rng(23)
+    b, g, n_kv, t, bs, layer = 2, 1, 2, 24, 8, 0
+    hk = n_kv * 16
+    bps = t // bs
+    q = jnp.asarray(rng.normal(size=(b, g, hk)).astype(np.float32))
+    raw = rng.normal(size=(2, 2, b, t, hk)).astype(np.float32)
+    amax = np.maximum(np.abs(raw).max(-1, keepdims=True), 1e-8)
+    scales = (amax / 127.0).astype(np.float32)
+    qslab = np.clip(np.round(raw / scales), -127, 127).astype(np.int8)
+    tables = (rng.permutation(b * bps) + 1).reshape(b, bps).astype(np.int32)
+    n_blocks = b * bps + 1
+    qblocks = _scatter_slab_to_blocks(qslab, tables, bs, n_blocks)
+    sblocks = _scatter_slab_to_blocks(scales, tables, bs, n_blocks)
+    pos = jnp.asarray(np.array([23, 7], np.int32))
+    out_paged = flash_decode_attention_paged(
+        q, jnp.asarray(qblocks), jnp.asarray(tables), pos, n_kv,
+        layer=layer, interpret=True, block_scales=jnp.asarray(sblocks),
+    )
+    out_slab = flash_decode_attention(
+        q, jnp.asarray(qslab), pos, n_kv, layer=layer, block_t=bs,
+        interpret=True, kv_scales=jnp.asarray(scales),
+    )
+    np.testing.assert_array_equal(np.asarray(out_paged),
+                                  np.asarray(out_slab))
+
+
+def test_flash_decode_paged_sentinel_blocks_are_invisible():
+    """Unallocated table entries point at the zero sentinel (id 0);
+    rows past ``pos`` are masked anyway, so a short sequence in a
+    sparsely-allocated table matches the dense reference."""
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        flash_decode_attention_paged,
+    )
+
+    rng = np.random.default_rng(29)
+    b, g, n_kv, t, bs = 1, 1, 2, 32, 8
+    hk = n_kv * 16
+    bps = t // bs
+    q = jnp.asarray(rng.normal(size=(b, g, hk)).astype(np.float32))
+    slab = rng.normal(size=(2, 2, b, t, hk)).astype(np.float32)
+    pos = 5  # only the first block is live
+    tables = np.zeros((b, bps), np.int32)
+    tables[0, 0] = 3  # arbitrary pool slot; the rest stay sentinel
+    blocks = np.zeros((2, 2, 8, bs, hk), np.float32)
+    blocks[:, :, 3] = slab[:, :, 0, :bs]
+    out = flash_decode_attention_paged(
+        q, jnp.asarray(blocks), jnp.asarray(tables),
+        jnp.asarray(np.array([pos], np.int32)), n_kv, layer=0,
+        interpret=True,
+    )
+    ref = _dense_decode_ref(q, jnp.asarray(slab), pos, n_kv, 0)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)

@@ -480,3 +480,95 @@ def test_slot_pool_generation_counter_detects_reuse():
     assert g2 == g1 + 1  # a stale block's gen no longer matches
     other = pool.acquire()
     assert pool.generation(other) == 1
+
+
+# -- paged pool block determinism (satellite) ----------------------------
+
+
+def _paged_pool(n_slots=2, block_size=8):
+    from deeplearning4j_tpu.serving import PagedKVPool
+    return PagedKVPool(CFG, n_slots, CFG.max_len, block_size=block_size)
+
+
+def test_paged_pool_block_alloc_lowest_id_first():
+    """Block ids come off a heap lowest-first (the block analogue of
+    the slot free-list test): allocation order is a pure function of
+    the request sequence, so identical runs produce identical tables."""
+    pool = _paged_pool()
+    s = pool.acquire()
+    pool.alloc_slot_blocks(s, 17)  # ceil(17/8) = 3 blocks
+    assert pool.slot_blocks(s) == [1, 2, 3]  # 0 is the zero sentinel
+    pool.release(s)
+    assert pool.n_blocks_in_use == 0
+    s2 = pool.acquire()
+    pool.alloc_slot_blocks(s2, 9)
+    assert pool.slot_blocks(s2) == [1, 2]  # freed ids reused, lowest first
+    extra = pool.alloc_blocks(2)
+    assert extra == [3, 4]
+    with pytest.raises(RuntimeError):
+        pool.alloc_blocks(pool.n_free_blocks + 1)
+
+
+def test_paged_pool_generation_counter_spans_block_reuse():
+    """Slot reuse bumps the generation even though the slot's KV now
+    lives in reallocated blocks — a stale pipelined readback keyed on
+    (slot, gen) is still discarded after the block-table rewrite."""
+    pool = _paged_pool(n_slots=1)
+    s = pool.acquire()
+    pool.alloc_slot_blocks(s, 16)
+    g1 = pool.generation(s)
+    old_blocks = pool.slot_blocks(s)
+    pool.release(s)
+    assert pool.table(s).tolist() == [0] * pool.blocks_per_slot
+    s2 = pool.acquire()
+    assert s2 == s
+    pool.alloc_slot_blocks(s2, 16)
+    assert pool.generation(s2) == g1 + 1
+    assert pool.slot_blocks(s2) == old_blocks  # same bytes, new gen
+
+
+def test_paged_pool_snapshot_identity_at_block_granularity():
+    """Two pools driven through the same acquire/alloc/alias/release
+    sequence end with byte-identical block tables and refcounts — the
+    block-granular snapshot-identity contract recovery replay and the
+    prefix cache's aliasing both lean on."""
+    def drive(pool):
+        a = pool.acquire()
+        b = pool.acquire()
+        pool.alloc_slot_blocks(a, 20)
+        pool.alloc_slot_blocks(b, 8)
+        shared = pool.slot_blocks(a)[:2]
+        pool.release(b)
+        b2 = pool.acquire()
+        pool.alias_into_slot(b2, shared)
+        pool.alloc_slot_blocks(b2, 24, start=2)
+        return pool
+
+    p1 = drive(_paged_pool())
+    p2 = drive(_paged_pool())
+    np.testing.assert_array_equal(p1.tables(), p2.tables())
+    assert [p1.refcount(i) for i in range(p1.n_blocks)] == \
+           [p2.refcount(i) for i in range(p2.n_blocks)]
+    # the aliased blocks really are shared (refcount 2), and releasing
+    # one owner keeps them alive for the other
+    shared = p1.slot_blocks(0)[:2]
+    assert all(p1.refcount(b) == 2 for b in shared)
+    p1.release(0)
+    assert all(p1.refcount(b) == 1 for b in shared)
+    assert p1.slot_blocks(1)[:2] == shared
+
+
+def test_paged_pool_reinit_restores_full_capacity():
+    """reinit() after a crash returns every block to the free heap and
+    zeroes every table — the pool-side half of the recovery contract
+    (PrefixCache.reinit drops its segment block refs WITHOUT decref,
+    relying on exactly this)."""
+    pool = _paged_pool()
+    a = pool.acquire()
+    pool.alloc_slot_blocks(a, 32)
+    assert pool.n_blocks_in_use > 0
+    pool.reinit()
+    assert pool.n_blocks_in_use == 0
+    assert pool.n_free_blocks == pool.n_blocks - 1  # all but sentinel
+    assert pool.tables().sum() == 0
+    assert pool.refcount(0) == 1  # sentinel stays pinned
