@@ -584,8 +584,12 @@ def cmd_serve(args) -> int:
         max_restarts=args.max_restarts,
         hang_threshold_s=args.hang_threshold,
         metrics_port=args.metrics_port,
+        flight_dir=args.flight_dir,
     )
     host, port = server.address
+    # name the process track after the bound address so trace-merge
+    # shows which replica is which (the port is only known post-bind)
+    tracer.process_name = f"serve {host}:{port}"
     print(f"serving on http://{host}:{port}  "
           f"({args.slots} slots, {engine.max_total} tokens/slot, "
           f"decode horizon {engine.decode_horizon}"
@@ -770,11 +774,16 @@ def cmd_router(args) -> int:
     prompt prefix (least-loaded otherwise), polls each replica's
     /healthz, and retries never-accepted requests when a replica
     dies. See serving/router.py."""
-    from deeplearning4j_tpu.obs import configure_json_logging
+    from deeplearning4j_tpu.obs import Tracer, configure_json_logging
     from deeplearning4j_tpu.serving.router import ReplicaRouter
 
     if args.log_json:
         configure_json_logging()
+    tracer = Tracer(
+        enabled=args.trace_out is not None,
+        capacity=args.trace_capacity,
+        process_name="router",
+    )
     sans = None
     if args.sanitize:
         from deeplearning4j_tpu.analysis.sanitizers import (
@@ -793,32 +802,65 @@ def cmd_router(args) -> int:
             affinity_min_match=args.affinity_min_match,
             health_interval_s=args.health_interval,
             request_timeout_s=args.request_timeout,
+            tracer=tracer,
+            flight_dir=args.flight_dir,
         )
     except ValueError as e:
         print(f"router: {e}", file=sys.stderr)
         return 2
     host, port = router.address
+    tracer.process_name = f"router {host}:{port}"
     names = ", ".join(r.name for r in router.replicas)
     print(f"routing on http://{host}:{port} -> [{names}]  "
           f"(affinity >= {args.affinity_min_match} tokens, "
           f"health poll {args.health_interval:g}s)")
-    if args.port_file:
-        router.start()
-        tmp = f"{args.port_file}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"host": host, "port": port}, f)
-        os.replace(tmp, args.port_file)
-        try:
-            while True:
-                time.sleep(1)
-        except KeyboardInterrupt:
-            pass
-        finally:
-            router.stop()
-    else:
-        router.serve_forever()
+    try:
+        if args.port_file:
+            router.start()
+            tmp = f"{args.port_file}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"host": host, "port": port}, f)
+            os.replace(tmp, args.port_file)
+            try:
+                while True:
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                router.stop()
+        else:
+            router.serve_forever()
+    finally:
+        if args.trace_out:
+            out = tracer.export(args.trace_out)
+            print(f"trace: {tracer.n_events} events "
+                  f"({tracer.dropped} dropped) -> {out}")
     if sans is not None:
         return _report_sanitizers(None, *sans)
+    return 0
+
+
+def cmd_trace_merge(args) -> int:
+    """Stitch per-process Chrome-trace exports (each written by a
+    serve/router --trace-out) into one Perfetto document: one process
+    track per input, timestamps rebased onto a shared wall-clock
+    origin, and flow arrows linking router dispatch spans to the
+    replica admission spans they parented."""
+    from deeplearning4j_tpu.obs.collect import merge_trace_files
+
+    try:
+        merged = merge_trace_files(args.traces, out_path=args.out)
+    except (OSError, ValueError) as e:
+        print(f"trace-merge: {e}", file=sys.stderr)
+        return 2
+    evs = merged["traceEvents"]
+    n_pids = len({e["pid"] for e in evs})
+    n_spans = sum(1 for e in evs if e.get("ph") == "X")
+    n_flows = sum(1 for e in evs if e.get("ph") == "s")
+    print(f"merged {len(args.traces)} traces -> {args.out}: "
+          f"{n_pids} process tracks, {n_spans} spans, "
+          f"{n_flows} cross-process links "
+          f"(open at https://ui.perfetto.dev)")
     return 0
 
 
@@ -1069,6 +1111,13 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("--trace-capacity", type=int, default=1 << 16,
                    help="tracer ring-buffer size in events (oldest "
                    "overwritten beyond this)")
+    v.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="write crash flight-recorder bundles (JSON "
+                   "postmortems: recent engine events, metrics, trace "
+                   "tail — prompts redacted) to DIR on engine crash, "
+                   "watchdog trip, or SIGTERM; also honours "
+                   "DL4J_TPU_FLIGHT_DIR. GET /debug/dump serves the "
+                   "live bundle regardless")
     v.add_argument("--log-json", action="store_true",
                    help="structured JSON logs (one object per line on "
                    "stderr) with req_id correlation across scheduler/"
@@ -1165,6 +1214,16 @@ def main(argv: list[str] | None = None) -> int:
     r.add_argument("--health-interval", type=float, default=0.5,
                    help="seconds between /healthz polls of each replica")
     r.add_argument("--request-timeout", type=float, default=300.0)
+    r.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="enable the router's dispatch tracer and write "
+                   "its Chrome-trace/Perfetto JSON to PATH on shutdown "
+                   "(merge with replica traces via trace-merge)")
+    r.add_argument("--trace-capacity", type=int, default=1 << 16,
+                   help="tracer ring-buffer size in events")
+    r.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="write the router's flight-recorder bundle to "
+                   "DIR on SIGTERM; also honours DL4J_TPU_FLIGHT_DIR. "
+                   "GET /debug/dump serves the live bundle regardless")
     r.add_argument("--log-json", action="store_true")
     r.add_argument("--port-file", default=None, metavar="PATH",
                    help="write the bound address as JSON to PATH once "
@@ -1176,6 +1235,18 @@ def main(argv: list[str] | None = None) -> int:
                    "threads and exit nonzero at shutdown if any "
                    "violation was recorded")
     r.set_defaults(fn=cmd_router)
+
+    m = sub.add_parser(
+        "trace-merge",
+        help="stitch per-process --trace-out exports (router + "
+        "replicas) into one Perfetto trace with cross-process flow "
+        "arrows from router dispatch spans to replica admissions",
+    )
+    m.add_argument("traces", nargs="+", metavar="TRACE.json",
+                   help="per-process Chrome-trace JSON files")
+    m.add_argument("-o", "--out", required=True, metavar="PATH",
+                   help="merged Perfetto JSON output path")
+    m.set_defaults(fn=cmd_trace_merge)
 
     L = sub.add_parser(
         "lint",

@@ -24,6 +24,11 @@ training orchestrator — can depend on it without cycles:
   (``POST /profile?s=N`` on the serving server, or a CLI flag).
 """
 
+from deeplearning4j_tpu.obs.collect import (  # noqa: F401
+    merge_trace_files,
+    merge_traces,
+)
+from deeplearning4j_tpu.obs.flight import FlightRecorder, redact  # noqa: F401
 from deeplearning4j_tpu.obs.logs import (  # noqa: F401
     JsonLogFormatter,
     configure_json_logging,
@@ -36,4 +41,10 @@ from deeplearning4j_tpu.obs.registry import (  # noqa: F401
     MetricsRegistry,
     Reservoir,
 )
-from deeplearning4j_tpu.obs.trace import Tracer  # noqa: F401
+from deeplearning4j_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)

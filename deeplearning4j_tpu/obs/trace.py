@@ -32,12 +32,22 @@ both ``chrome://tracing`` and https://ui.perfetto.dev load): complete
 events (``ph: "X"``) with microsecond ``ts``/``dur``, one ``tid`` per
 track with ``thread_name``/``thread_sort_index`` metadata so the
 engine loop sorts above the slot tracks.
+
+Fleet tracing: span ``args`` may carry W3C-style ``trace_id`` /
+``span_id`` / ``parent_span_id`` values (see :func:`new_trace_id`,
+:func:`parse_traceparent`). The exporter additionally records a
+wall-clock anchor (``origin_wall_time_s``) so per-process exports —
+whose ``perf_counter`` origins are not comparable — can be rebased
+onto one timeline by :mod:`deeplearning4j_tpu.obs.collect` and viewed
+as a single Perfetto document with cross-process flow arrows.
 """
 
 from __future__ import annotations
 
 import contextlib
 import json
+import os
+import re
 import time
 from collections import deque
 from pathlib import Path
@@ -46,9 +56,43 @@ from pathlib import Path
 ENGINE_TRACK = "engine"
 SCHEDULER_TRACK = "scheduler"
 
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
 
 def slot_track(slot: int) -> str:
     return f"slot-{slot}"
+
+
+def new_trace_id() -> str:
+    """Fresh 128-bit trace id (32 lowercase hex chars, W3C format)."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """Fresh 64-bit span id (16 lowercase hex chars, W3C format)."""
+    return os.urandom(8).hex()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """W3C ``traceparent`` header value (version 00, sampled)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """``(trace_id, parent_span_id)`` from a ``traceparent`` header,
+    or ``None`` when the header is absent/malformed/all-zero (the spec
+    says all-zero ids are invalid — treat as absent and start fresh)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    trace_id, span_id = m.group(1), m.group(2)
+    if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return trace_id, span_id
 
 
 class Tracer:
@@ -59,16 +103,22 @@ class Tracer:
     the buffer, so they can run concurrently with recording.
     """
 
-    def __init__(self, enabled: bool = True, capacity: int = 1 << 16):
+    def __init__(self, enabled: bool = True, capacity: int = 1 << 16,
+                 process_name: str = "deeplearning4j_tpu"):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.enabled = bool(enabled)
         self.capacity = int(capacity)
+        self.process_name = str(process_name)
         self._events: deque = deque(maxlen=self.capacity)
         self._n_recorded = 0
         # export origin: spans use absolute perf_counter stamps; the
-        # exporter rebases them so ts starts near zero
+        # exporter rebases them so ts starts near zero. The wall-clock
+        # anchor is taken at the same instant, giving cross-process
+        # merges (obs.collect) a common base: exported relative ts=0
+        # corresponds to wall time origin_wall_time_s.
         self._t0 = time.perf_counter()
+        self._wall0 = time.time()
 
     # -- recording ---------------------------------------------------------
 
@@ -156,7 +206,7 @@ class Tracer:
         }
         out = [
             {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
-             "args": {"name": "deeplearning4j_tpu"}},
+             "args": {"name": self.process_name}},
         ]
         for track, tid in tids.items():
             out.append({"name": "thread_name", "ph": "M", "pid": 1,
@@ -176,7 +226,13 @@ class Tracer:
             if args:
                 ev["args"] = args
             out.append(ev)
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            # wall time (time.time) at exported ts=0 — the merge anchor
+            "origin_wall_time_s": self._wall0,
+            "process_name": self.process_name,
+        }
 
     def export(self, path: str | Path) -> Path:
         """Write the Chrome-trace JSON to ``path`` (open the file at

@@ -140,12 +140,14 @@ from deeplearning4j_tpu.models.transformer import (
     serving_tp_cache_sharding,
 )
 from deeplearning4j_tpu.parallel.mesh import model_parallel_mesh
+from deeplearning4j_tpu.obs.flight import FlightRecorder
 from deeplearning4j_tpu.obs.logs import log_event
 from deeplearning4j_tpu.obs.profiler import ProfileTrigger
 from deeplearning4j_tpu.obs.trace import (
     ENGINE_TRACK,
     SCHEDULER_TRACK,
     Tracer,
+    new_span_id,
     slot_track,
 )
 from deeplearning4j_tpu.serving.cache_pool import KVSlotPool, PagedKVPool
@@ -755,6 +757,8 @@ class ServingEngine:
         max_backoff_s: float = 0.25,
         results_cap: int = 1024,
         tracer: Tracer | None = None,
+        flight: FlightRecorder | None = None,
+        attribution: bool = True,
         profile: ProfileTrigger | None = None,
         tp: int = 1,
         tp_parity: bool | str = "auto",
@@ -769,6 +773,18 @@ class ServingEngine:
     ):
         self.n_slots = n_slots
         self.max_total = int(min(max_total or cfg.max_len, cfg.max_len))
+        # per-program-family device-time attribution (see _attr /
+        # _flush_attr): armed at the END of __init__ so construction
+        # probes never count, same "probes don't count" contract as
+        # prefill_dispatches. _attr_suspend re-suspends during runtime
+        # probes and recovery replay.
+        self._attr_enabled = False
+        self._attr_suspend = 0
+        self._pending_attr: list[tuple[str, float]] = []
+        # crash flight recorder: enabled by default (one deque.append
+        # per horizon/admission — postmortems must exist BEFORE the
+        # incident, so this is not opt-in like the tracer)
+        self.flight = flight if flight is not None else FlightRecorder()
         # parity-probe verdict persistence (per config x backend x
         # program geometry): repeated engine instances — replica
         # fleets, restarts, tests — skip the cold-start probe
@@ -1126,6 +1142,40 @@ class ServingEngine:
         self._paged_seg_fetch_fn = None
         self._block_copy_fn = None
         self._paged_admit_donate = self._donate("paged_prefill")
+        # arm attribution last: everything dispatched above was a probe
+        self._attr_enabled = bool(attribution)
+
+    # -- per-program-family attribution ------------------------------------
+
+    def _attr(self, family: str, t0: float | None = None) -> None:
+        """Mark one dispatched program for device-time attribution:
+        ``(family, dispatch timestamp)`` joins the pending list, and
+        ``_flush_attr`` prices it at the next horizon readback that
+        PROVES it complete (device stream ordering). One attribute
+        check + one list append on the hot path; nothing at all when
+        disabled, and never a device sync either way."""
+        if self._attr_enabled and not self._attr_suspend:
+            self._pending_attr.append(
+                (family, t0 if t0 is not None else time.perf_counter())
+            )
+
+    def _flush_attr(self, t_horizon: float, now: float) -> None:
+        """Attribute every pending program dispatched no later than
+        the just-synced horizon (``t_horizon`` is its dispatch stamp):
+        the designated readback proved all of them complete, so each
+        gets ``now - t0`` seconds — dispatch call to proven-complete,
+        an honest upper bound that includes async overlap with host
+        work rather than pretending per-program device intervals are
+        observable without extra syncs. Entries are time-ordered
+        (single engine thread), so this is a prefix flush."""
+        n = 0
+        for family, t0 in self._pending_attr:
+            if t0 > t_horizon:
+                break
+            self.metrics.record_program(family, now - t0)
+            n += 1
+        if n:
+            del self._pending_attr[:n]
 
     def _register_gauges(self) -> None:
         """Live-state gauges on the metrics registry: scrapes read
@@ -1524,7 +1574,8 @@ class ServingEngine:
         self.tracer.instant(SCHEDULER_TRACK, "submit", req_id=rid)
         log_event(_log, "request_submitted", level=logging.DEBUG,
                   req_id=rid, prompt_len=len(req.prompt),
-                  max_new=req.max_new, tenant=req.tenant_id or None)
+                  max_new=req.max_new, tenant=req.tenant_id or None,
+                  trace_id=req.trace_id or None)
         return rid
 
     @property
@@ -1622,7 +1673,8 @@ class ServingEngine:
         )
         log_event(_log, "request_retired", req_id=req.id, slot=slot,
                   status=status.value, n_tokens=len(st.tokens),
-                  error=error, tenant=req.tenant_id or None)
+                  error=error, tenant=req.tenant_id or None,
+                  trace_id=req.trace_id or None)
         if req.stream is not None:
             req.stream.put(None)  # end-of-stream sentinel
         if req.done is not None:
@@ -1639,7 +1691,8 @@ class ServingEngine:
         )
         log_event(_log, "request_retired", req_id=req.id, slot=None,
                   status=status.value, n_tokens=0, error=error,
-                  tenant=req.tenant_id or None)
+                  tenant=req.tenant_id or None,
+                  trace_id=req.trace_id or None)
         if req.stream is not None:
             req.stream.put(None)  # end-of-stream sentinel
         if req.done is not None:
@@ -1743,6 +1796,7 @@ class ServingEngine:
             pad = np.zeros((1, b), np.int32)
             pad[0, :n] = seq
             self.prefill_dispatches += 1
+            self._attr("paged_prefill" if paged else "prefill")
             pf = self._paged_prefill_fn(b) if paged else self._prefill_fn(b)
             return pf(
                 *state, self.params, jnp.asarray(pad), jnp.int32(n - 1),
@@ -1758,6 +1812,7 @@ class ServingEngine:
         for t0, ln, b in self._chunk_schedule(n):
             pad = np.zeros((1, b), np.int32)
             pad[0, :ln] = seq[t0:t0 + ln]
+            self._attr("chunk")
             tmp, lg = self._chunk_fn(b)(
                 self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
                 jnp.int32(ln - 1), ad,
@@ -1895,6 +1950,7 @@ class ServingEngine:
         if n <= L:
             return False
         _disp = self.prefill_dispatches  # probes don't count
+        self._attr_suspend += 1  # nor toward device-time attribution
         try:
             seq = ((1 + np.arange(n)) % self.cfg.vocab_size).astype(
                 np.int32
@@ -1936,6 +1992,7 @@ class ServingEngine:
             )
         finally:
             self.prefill_dispatches = _disp
+            self._attr_suspend -= 1
 
     def _probe_batch_parity(self) -> bool:
         """One-time probe gating batched admission: do the batched
@@ -1951,6 +2008,7 @@ class ServingEngine:
         n1 = n0 - 1
         b = self._bucket_for(n0)
         _disp = self.prefill_dispatches  # probes don't count
+        self._attr_suspend += 1  # nor toward device-time attribution
         try:
             vs = self.cfg.vocab_size
             seq0 = ((1 + np.arange(n0)) % vs).astype(np.int32)
@@ -2024,6 +2082,7 @@ class ServingEngine:
             return self._states_equal(sh, sbh)
         finally:
             self.prefill_dispatches = _disp
+            self._attr_suspend -= 1
 
     def _probe_verdict(self, name: str, compute, cfg=None,
                        **geometry) -> bool:
@@ -2393,6 +2452,7 @@ class ServingEngine:
         for t0, ln, b in self._chunk_schedule(n, start=L):
             pad = np.zeros((1, b), np.int32)
             pad[0, :ln] = seq[t0:t0 + ln]
+            self._attr("chunk")
             tmp, lg = self._chunk_fn(b)(
                 self.params, tmp, jnp.asarray(pad), jnp.int32(t0),
                 jnp.int32(ln - 1),
@@ -2439,6 +2499,7 @@ class ServingEngine:
                 eos_toks[r] = int(pl.req.eos_token)
             adapters[r] = pl.req.adapter
         self.prefill_dispatches += 1
+        self._attr("batch_prefill")
         self._set_state(self._batch_prefill_fn(bucket, nb)(
             *self._state(), self.params, jnp.asarray(prompts),
             jnp.asarray(last_idx), jnp.asarray(slots),
@@ -2477,6 +2538,7 @@ class ServingEngine:
                 eos_toks[r] = int(pl.req.eos_token)
             adapters[r] = pl.req.adapter
         self.prefill_dispatches += 1
+        self._attr("batch_hit")
         self._set_state(self._batch_hit_fn(bucket, nb)(
             *self._state(), self.params, self.prefix_cache.region,
             jnp.asarray(seg_idx), jnp.asarray(toks), jnp.int32(L),
@@ -2513,10 +2575,26 @@ class ServingEngine:
                 SCHEDULER_TRACK, "queued", req.arrival_time,
                 delay, req_id=req.id,
             )
+        # the ADMISSION span: when the request carries distributed-
+        # trace context (router/server resolved a traceparent), the
+        # span joins the fleet trace — parent_span_id is the upstream
+        # dispatch span, so trace-merge draws the cross-process arrow
+        # into this span
+        tctx = {}
+        if self.tracer.enabled and req.trace_id:
+            tctx = {"trace_id": req.trace_id, "span_id": new_span_id()}
+            if req.parent_span_id:
+                tctx["parent_span_id"] = req.parent_span_id
         self.tracer.span(
             slot_track(slot), "prefill", pl.t_pf, pl.prefill_s,
             req_id=req.id, prompt_len=len(req.prompt),
-            prefix=pl.kind, cached_tokens=pl.matched,
+            prefix=pl.kind, cached_tokens=pl.matched, **tctx,
+        )
+        self.flight.record(
+            "admit", req_id=req.id, slot=slot,
+            prompt_len=len(req.prompt), prefix=pl.kind,
+            tenant=req.tenant_id or None,
+            trace_id=req.trace_id or None,
         )
         log_event(_log, "request_admitted", req_id=req.id,
                   slot=slot, prompt_len=len(req.prompt),
@@ -2524,7 +2602,8 @@ class ServingEngine:
                   prefill_s=round(pl.prefill_s, 6),
                   prefix=pl.kind, cached_tokens=pl.matched,
                   tenant=req.tenant_id or None,
-                  adapter=req.adapter or None)
+                  adapter=req.adapter or None,
+                  trace_id=req.trace_id or None)
 
     def _maybe_insert_prefix(self, pl: _AdmitPlan) -> None:
         """Insert-on-completion (of the prefill): cache the admitted
@@ -2830,6 +2909,9 @@ class ServingEngine:
                 self.tracer.instant(
                     ENGINE_TRACK, "retry", site="step", error=str(e)
                 )
+                self.flight.record("fault", fault="transient",
+                                   site="step", error=str(e),
+                                   attempt=attempt + 1)
                 attempt += 1
                 if attempt <= self.max_retries:
                     time.sleep(backoff)
@@ -2848,6 +2930,8 @@ class ServingEngine:
                     return None
                 attempt, backoff = 0, self.retry_backoff_s
             except PermanentFault as e:
+                self.flight.record("fault", fault="permanent",
+                                   site="step", error=str(e))
                 slot = self._slot_of(e.req_id)
                 if slot is None:
                     raise EngineCrash(
@@ -2858,6 +2942,12 @@ class ServingEngine:
                              deactivate=True)
                 if not any(st is not None for st in self._slots):
                     return None
+            except EngineCrash as e:
+                # injected whole-engine crash: the last flight event
+                # before the supervisor's postmortem dump names it
+                self.flight.record("fault", fault="crash", site="step",
+                                   error=str(e))
+                raise
         now = time.perf_counter()
         self.last_dispatch_t = now
         if self._san is not None:
@@ -2871,6 +2961,15 @@ class ServingEngine:
             ENGINE_TRACK, "dispatch", t_call, now - t_call,
             n_active=len(snaps),
         )
+        self._attr("paged_step" if self._paged else "step", t_call)
+        if self.flight.enabled:
+            self.flight.record(
+                "dispatch", k=k, n_active=len(snaps),
+                queue_depth=len(self.scheduler),
+                **({"blocks_in_use": self.pool.n_blocks_in_use,
+                    "blocks_free": self.pool.n_free_blocks}
+                   if self._paged else {}),
+            )
         return _Inflight(toks, snaps, now)
 
     # lint: hot-path
@@ -2892,6 +2991,10 @@ class ServingEngine:
             overlap_s=max(0.0, t_sync - horizon.t_dispatch),
         )
         self.tracer.span(ENGINE_TRACK, "sync", t_sync, now - t_sync)
+        # the sync above proved every program dispatched at or before
+        # this horizon complete — price the pending attribution entries
+        if self._pending_attr:
+            self._flush_attr(horizon.t_dispatch, now)
         # per-slot decode span for this horizon: dispatch → block
         # arrival, clipped at the NEXT horizon's dispatch (which already
         # happened — pipelining) so consecutive decode spans on one slot
@@ -3017,10 +3120,12 @@ class ServingEngine:
         if k < 1:
             return False
         _disp = self.prefill_dispatches  # probes don't count
+        self._attr_suspend += 1  # nor toward device-time attribution
         try:
             return self._probe_chunked_parity_inner(length, k)
         finally:
             self.prefill_dispatches = _disp
+            self._attr_suspend -= 1
 
     def _probe_chunked_parity_inner(self, length: int, k: int) -> bool:
         seq = ((1 + np.arange(length)) % self.cfg.vocab_size).astype(
@@ -3090,6 +3195,23 @@ class ServingEngine:
         t_rec = time.perf_counter()
         self.metrics.record_restart()
         self.tracer.instant(ENGINE_TRACK, "crash", ts=t_rec)
+        self.flight.record(
+            "restart", n_live=sum(
+                1 for st in self._slots if st is not None
+            ), queue_depth=len(self.scheduler),
+            restarts=self.metrics.n_restarts,
+        )
+        # pending attribution entries lost their completion proof with
+        # the abandoned device state; replay dispatches don't count
+        # (recovery wall time is not serving device time)
+        self._pending_attr.clear()
+        self._attr_suspend += 1
+        try:
+            return self._recover_inner(t_rec)
+        finally:
+            self._attr_suspend -= 1
+
+    def _recover_inner(self, t_rec: float) -> int:
         self._inflight = None
         live = [(s, st) for s, st in enumerate(self._slots)
                 if st is not None]

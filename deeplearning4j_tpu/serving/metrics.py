@@ -84,6 +84,57 @@ PHASES = ("queue", "prefill", "decode", "sync")
 #: n/total/min/max are kept alongside)
 RESERVOIR_CAP = 4096
 
+#: peak dense matmul FLOP/s per chip by jax device_kind prefix (bf16
+#: inputs, f32 accumulation — the MXU-native rate; same table the
+#: bench harness reports MFU against, duplicated here because the
+#: package cannot import the repo-root bench script)
+_PEAK_FLOPS = (
+    ("TPU v6", 918e12),   # Trillium
+    ("TPU v5p", 459e12),
+    ("TPU v5 lite", 197e12),  # v5e
+    ("TPU v5", 459e12),
+    ("TPU v4", 275e12),
+)
+
+#: peak HBM bandwidth per chip (bytes/s), by device_kind prefix
+_PEAK_HBM_BW = (
+    ("TPU v6", 1640e9),   # Trillium
+    ("TPU v5p", 2765e9),
+    ("TPU v5 lite", 819e9),  # v5e
+    ("TPU v5", 2765e9),
+    ("TPU v4", 1228e9),
+)
+
+#: generous non-TPU fallbacks (modern server CPU with all cores +
+#: AMX-class units / DDR5 channels) — on CI the gauges must stay
+#: defined and inside (0, 1], not be calibrated
+_FALLBACK_PEAK_FLOPS = 5e12
+_FALLBACK_PEAK_HBM_BW = 1e12
+
+
+def _device_peaks() -> tuple[float, float]:
+    """``(peak flop/s, peak bytes/s)`` for device 0: table-resolved on
+    TPU, the generous fallback elsewhere (the gauge help strings say
+    which regime is calibrated)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform == "tpu":
+            kind = getattr(dev, "device_kind", "")
+            flops = next(
+                (p for pre, p in _PEAK_FLOPS if kind.startswith(pre)),
+                _PEAK_FLOPS[-1][1],
+            )
+            bw = next(
+                (p for pre, p in _PEAK_HBM_BW if kind.startswith(pre)),
+                _PEAK_HBM_BW[-1][1],
+            )
+            return flops, bw
+    except Exception:
+        pass
+    return _FALLBACK_PEAK_FLOPS, _FALLBACK_PEAK_HBM_BW
+
 
 def _pct(res: Reservoir, p: float) -> float:
     return float(np.percentile(np.asarray(res.values, np.float64), p))
@@ -106,6 +157,13 @@ class ServingMetrics:
         self.overlap = Reservoir(reservoir_cap)
         # exact per-phase wall-second totals (see module docstring)
         self.phase_seconds = {p: 0.0 for p in PHASES}
+        # per-program-family device-time attribution (record_program):
+        # measured at the horizon-readback boundary by the engine
+        # thread only, like phase_seconds, so no lock
+        self.program_seconds: dict[str, float] = {}
+        self.program_dispatches: dict[str, int] = {}
+        self._family_budgets: dict | None = None  # lazy .graftaudit.json
+        self._peaks: tuple[float, float] | None = None  # lazy device peek
         # stamped by the engine at construction; reported in summary()
         # so a bench row records which horizon produced its numbers
         self.decode_horizon = 1
@@ -232,6 +290,31 @@ class ServingMetrics:
             "serve_embedding_seconds",
             "Embedding request service time (host-side lookup).",
         )
+        self._c_prog_seconds = reg.counter(
+            "serve_program_seconds_total",
+            "Wall seconds attributed to compiled program families at "
+            "the horizon-readback boundary (dispatch call to "
+            "post-sync flush — an honest upper bound that includes "
+            "async overlap).", ("family",),
+        )
+        self._c_prog_dispatches = reg.counter(
+            "serve_program_dispatches_total",
+            "Program dispatches by compiled family.", ("family",),
+        )
+        self._g_mfu = reg.gauge(
+            "serve_mfu",
+            "Live model-flop utilization per program family: audited "
+            "envelope flops x dispatches / measured seconds / device "
+            "peak, clamped to 1. Exact at the committed audit "
+            "geometry; a scale reference otherwise.", ("family",),
+        )
+        self._g_mbu = reg.gauge(
+            "serve_mbu",
+            "Live memory-bandwidth utilization per program family: "
+            "audited arg+out bytes x dispatches / measured seconds / "
+            "peak HBM bandwidth, clamped to 1. Exact at the committed "
+            "audit geometry; a scale reference otherwise.", ("family",),
+        )
 
     def _emit(self, tag: str, value: float, step: int | None = None) -> None:
         if self.writer is not None:
@@ -257,6 +340,21 @@ class ServingMetrics:
         """Attribute ``seconds`` of wall time to a request phase."""
         self.phase_seconds[phase] += seconds
         self._h_phase.observe(seconds, phase=phase)
+
+    def record_program(self, family: str, seconds: float) -> None:
+        """Attribute one program dispatch's measured wall interval to
+        its compiled family. The engine calls this at the horizon-
+        readback boundary (after THE designated sync), so ``seconds``
+        spans dispatch call → proven-complete — an honest upper bound
+        that includes whatever host work overlapped the device."""
+        self.program_seconds[family] = (
+            self.program_seconds.get(family, 0.0) + float(seconds)
+        )
+        self.program_dispatches[family] = (
+            self.program_dispatches.get(family, 0) + 1
+        )
+        self._c_prog_seconds.inc(float(seconds), family=family)
+        self._c_prog_dispatches.inc(family=family)
 
     def record_step(self, n_active: int, n_slots: int,
                     queue_depth: int) -> None:
@@ -444,10 +542,49 @@ class ServingMetrics:
                     burn = _pct(st["tpot"], 99) / target
                     self._g_slo_burn.set(burn, tenant=tid)
 
+    def _update_program_util(self) -> None:
+        """Refresh the per-family MFU/MBU gauges: measured seconds
+        (``record_program``) divided into the static flop/byte budgets
+        committed in ``.graftaudit.json``. The registry entry IS the
+        live program (graftaudit enforces the surface), so the
+        attribution is exact, not heuristic — exact at the audit
+        geometry, where the envelope budgets match the dispatched
+        shapes. Render-time only: the hot path never touches this."""
+        if not self.program_dispatches:
+            return
+        if self._family_budgets is None:
+            try:
+                from deeplearning4j_tpu.analysis.programs import (
+                    family_budgets,
+                )
+
+                self._family_budgets = family_budgets()
+            except Exception:
+                self._family_budgets = {}
+        if not self._family_budgets:
+            return  # no committed baseline: seconds-only attribution
+        if self._peaks is None:
+            self._peaks = _device_peaks()
+        peak_flops, peak_bw = self._peaks
+        for family, n in self.program_dispatches.items():
+            budget = self._family_budgets.get(family)
+            secs = self.program_seconds.get(family, 0.0)
+            if budget is None or secs <= 0.0:
+                continue
+            self._g_mfu.set(
+                min(1.0, budget["flops"] * n / secs / peak_flops),
+                family=family,
+            )
+            self._g_mbu.set(
+                min(1.0, budget["bytes"] * n / secs / peak_bw),
+                family=family,
+            )
+
     def render_prometheus(self) -> str:
         """The backing registry in Prometheus text format (what the
         serving server returns at ``GET /metrics``)."""
         self._update_slo_burn()
+        self._update_program_util()
         return self.registry.render()
 
     def summary(self) -> dict:
@@ -522,6 +659,14 @@ class ServingMetrics:
             # batch" a continuous batcher is supposed to keep > 1
             out["occupancy_mean"] = self.occupancy.mean
             out["queue_depth_max"] = int(self.queue_depth.max)
+        if self.program_dispatches:
+            out["program_seconds"] = {
+                f: round(v, 6)
+                for f, v in sorted(self.program_seconds.items())
+            }
+            out["program_dispatches"] = dict(
+                sorted(self.program_dispatches.items())
+            )
         attributed = sum(self.phase_seconds.values())
         if attributed > 0:
             out["phase_seconds"] = {

@@ -32,25 +32,44 @@ accepted: a connect/send failure means the replica never admitted it),
 and a background poller flips the replica back to healthy once its
 ``/healthz`` answers 200 again.
 
+Fleet tracing: the router is the natural trace root. It adopts the
+caller's W3C ``traceparent`` (or starts a trace), and injects a fresh
+dispatch span id downstream on EVERY forward attempt — including
+retries onto survivors — so the merged Perfetto view (``trace-merge``)
+shows the failed attempt and the retry as sibling spans under one
+trace, each linked by a flow arrow to the replica's admission span.
+
 Endpoints: ``POST /v1/generate`` (routed passthrough; replica status
 codes and bodies are forwarded verbatim, plus ``X-Served-By``),
 ``GET /healthz`` (200 while >= 1 replica is healthy), ``GET /replicas``
 (per-replica routing state), ``GET /metrics`` (Prometheus text for the
-router's own counters/gauges, labelled per replica).
+router's own counters/gauges, labelled per replica),
+``GET /debug/dump`` (flight-recorder postmortem bundle).
 """
 
 from __future__ import annotations
 
 import http.client
 import logging
+import os
+import signal
 import threading
 import time
 from http.server import ThreadingHTTPServer
+from pathlib import Path
 from urllib.parse import urlparse
 
 from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
+from deeplearning4j_tpu.obs.flight import FlightRecorder
 from deeplearning4j_tpu.obs.logs import log_event
 from deeplearning4j_tpu.obs.registry import MetricsRegistry
+from deeplearning4j_tpu.obs.trace import (
+    Tracer,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
 from deeplearning4j_tpu.utils.httpjson import (
     QuietHandler,
     read_json_body,
@@ -62,6 +81,9 @@ _log = logging.getLogger(__name__)
 
 #: Prometheus text exposition format version served at /metrics
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: the router's single trace track (it has one logical timeline)
+ROUTER_TRACK = "router"
 
 
 class _ReplicaDown(Exception):
@@ -181,7 +203,10 @@ class ReplicaRouter:
     def __init__(self, replicas, host: str = "127.0.0.1", port: int = 0,
                  affinity_min_match: int = 8,
                  health_interval_s: float = 0.5,
-                 request_timeout_s: float = 300.0):
+                 request_timeout_s: float = 300.0,
+                 tracer: Tracer | None = None,
+                 flight: FlightRecorder | None = None,
+                 flight_dir: str | None = None):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = [
@@ -190,6 +215,14 @@ class ReplicaRouter:
         self.affinity_min_match = int(affinity_min_match)
         self.health_interval_s = float(health_interval_s)
         self.request_timeout_s = float(request_timeout_s)
+        self.tracer = tracer if tracer is not None else Tracer(
+            enabled=False, process_name="router")
+        # enabled by default, like the replica engines: the postmortem
+        # has to exist before the incident
+        self.flight = flight if flight is not None else FlightRecorder()
+        self.flight_dir = (flight_dir if flight_dir is not None
+                           else os.environ.get("DL4J_TPU_FLIGHT_DIR")
+                           or None)
         self._stop = threading.Event()
         self._route_lock = wrap_lock(
             threading.Lock(), "router._route_lock"
@@ -219,6 +252,17 @@ class ReplicaRouter:
             "router_replica_in_flight",
             "Router-side in-flight requests, per replica.",
             labelnames=("replica",))
+        self._h_e2e = reg.histogram(
+            "router_e2e_seconds",
+            "End-to-end routed latency: pick + forward, including any "
+            "retries onto surviving replicas.")
+        self._h_ttft = reg.histogram(
+            "router_replica_ttft_seconds",
+            "Per-replica time from forward to the replica's response "
+            "headers. The routed passthrough buffers whole bodies, so "
+            "for generate this is the replica's full service time — "
+            "the router's honest first-byte bound.",
+            labelnames=("replica",))
         for r in self.replicas:
             self._m_healthy.set(1.0, replica=r.name)
             self._m_in_flight.set(0.0, replica=r.name)
@@ -236,6 +280,9 @@ class ReplicaRouter:
                 elif path == "/metrics":
                     send_body(self, 200, reg.render().encode(),
                               PROM_CONTENT_TYPE)
+                elif path == "/debug/dump":
+                    send_json(self, 200,
+                              router.flight_bundle("debug_dump"))
                 else:
                     send_json(self, 404, {"error": "not found"})
 
@@ -250,7 +297,8 @@ class ReplicaRouter:
                 if body is None:
                     send_json(self, 400, {"error": "malformed JSON"})
                     return
-                code, payload, served_by = router.route(body)
+                code, payload, served_by = router.route(
+                    body, traceparent=self.headers.get("traceparent"))
                 # forward the replica's JSON verbatim, tagging which
                 # backend actually served it (observability + tests)
                 self.send_response(code)
@@ -325,63 +373,124 @@ class ReplicaRouter:
                 float(chosen.in_flight), replica=chosen.name)
             return chosen, via_affinity
 
-    def _forward(self, replica: _Replica, raw: bytes) -> tuple[int, bytes]:
+    def _forward(self, replica: _Replica, raw: bytes,
+                 headers: dict) -> tuple[int, bytes]:
         """POST the raw body to the replica's generate endpoint.
         Transport failures and 503 (draining / dead engine) raise
         ``_ReplicaDown`` so the caller retries elsewhere."""
         conn = http.client.HTTPConnection(
             replica.host, replica.port, timeout=self.request_timeout_s)
         try:
-            conn.request(
-                "POST", "/v1/generate", body=raw,
-                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            conn.request("POST", "/v1/generate", body=raw,
+                         headers=headers)
             resp = conn.getresponse()
+            # response headers landed: the replica produced its first
+            # byte (see the histogram's help for what that means here)
+            ttft = time.perf_counter() - t0
             payload = resp.read()
             if resp.status == 503:
                 raise _ReplicaDown(f"{replica.name} answered 503")
+            self._h_ttft.observe(ttft, replica=replica.name)
             return resp.status, payload
         except (OSError, http.client.HTTPException) as e:
             raise _ReplicaDown(f"{replica.name}: {e}") from e
         finally:
             conn.close()
 
-    def route(self, body: dict) -> tuple[int, bytes, str | None]:
+    def route(self, body: dict,
+              traceparent: str | None = None
+              ) -> tuple[int, bytes, str | None]:
         """Route one generate request; returns
         ``(status, payload_bytes, replica_name | None)``. Retries on
         the remaining healthy replicas after transport-level failures
-        (the failed replica never accepted the request)."""
+        (the failed replica never accepted the request).
+
+        Trace context: the caller's ``traceparent`` is adopted (or a
+        trace started), and every forward attempt — retries included —
+        carries a fresh dispatch span id downstream, so the replica's
+        admission span parents to the attempt that actually reached it.
+        """
         import json
 
         self._m_requests.inc()
+        ctx = parse_traceparent(traceparent)
+        trace_id, parent_span = ctx if ctx else (new_trace_id(), "")
         tokens = self._prompt_tokens(body)
         raw = json.dumps(body).encode()
         exclude: set[str] = set()
-        while True:
-            try:
-                replica, via_affinity = self._pick(tokens, exclude)
-            except _ReplicaDown:
-                self._m_no_replica.inc()
-                return 503, json.dumps(
-                    {"error": "no healthy replica"}).encode(), None
-            self._m_routed.inc(replica=replica.name)
-            if via_affinity:
-                self._m_affinity.inc()
-            try:
-                status, payload = self._forward(replica, raw)
-                return status, payload, replica.name
-            except _ReplicaDown as e:
-                self._mark_unhealthy(replica, str(e))
-                with self._route_lock:
-                    replica.retried_away += 1
-                self._m_retries.inc()
-                exclude.add(replica.name)
-                log_event(_log, "router_retry", replica=replica.name,
-                          error=str(e))
-            finally:
-                with self._route_lock:
-                    replica.in_flight -= 1
-                    self._m_in_flight.set(
-                        float(replica.in_flight), replica=replica.name)
+        t_req = time.perf_counter()
+        attempt = 0
+        try:
+            while True:
+                try:
+                    replica, via_affinity = self._pick(tokens, exclude)
+                except _ReplicaDown:
+                    self._m_no_replica.inc()
+                    self.flight.record("no_replica", trace_id=trace_id,
+                                       attempts=attempt)
+                    return 503, json.dumps(
+                        {"error": "no healthy replica"}).encode(), None
+                attempt += 1
+                self._m_routed.inc(replica=replica.name)
+                if via_affinity:
+                    self._m_affinity.inc()
+                span_id = new_span_id()
+                headers = {
+                    "Content-Type": "application/json",
+                    "traceparent": format_traceparent(trace_id, span_id),
+                    "X-Served-By": replica.name,
+                }
+                if self.flight.enabled:
+                    self.flight.record(
+                        "dispatch", replica=replica.name,
+                        attempt=attempt, trace_id=trace_id,
+                        via_affinity=via_affinity)
+                t_try = time.perf_counter()
+                try:
+                    status, payload = self._forward(
+                        replica, raw, headers)
+                    self._trace_dispatch(
+                        trace_id, span_id, parent_span, replica.name,
+                        attempt, t_try, status=status)
+                    return status, payload, replica.name
+                except _ReplicaDown as e:
+                    self._trace_dispatch(
+                        trace_id, span_id, parent_span, replica.name,
+                        attempt, t_try, error=str(e))
+                    self._mark_unhealthy(replica, str(e))
+                    with self._route_lock:
+                        replica.retried_away += 1
+                    self._m_retries.inc()
+                    exclude.add(replica.name)
+                    self.flight.record("retry", replica=replica.name,
+                                       trace_id=trace_id, error=str(e))
+                    log_event(_log, "router_retry",
+                              replica=replica.name, error=str(e),
+                              trace_id=trace_id)
+                finally:
+                    with self._route_lock:
+                        replica.in_flight -= 1
+                        self._m_in_flight.set(
+                            float(replica.in_flight),
+                            replica=replica.name)
+        finally:
+            self._h_e2e.observe(time.perf_counter() - t_req)
+
+    def _trace_dispatch(self, trace_id: str, span_id: str,
+                        parent_span: str, replica: str, attempt: int,
+                        t0: float, **extra) -> None:
+        """One dispatch span on the router track; ``span_id`` is the
+        id this attempt injected downstream, which is what makes the
+        replica's admission span our child in the merged view."""
+        if not self.tracer.enabled:
+            return
+        args = {"trace_id": trace_id, "span_id": span_id,
+                "replica": replica, "attempt": attempt, **extra}
+        if parent_span:
+            args["parent_span_id"] = parent_span
+        self.tracer.span(ROUTER_TRACK, "dispatch", t0,
+                         time.perf_counter() - t0, **args)
 
     # ------------------------------------------------------------- #
     # health                                                         #
@@ -462,6 +571,41 @@ class ReplicaRouter:
     def address(self) -> tuple[str, int]:
         return self._httpd.server_address[:2]
 
+    @property
+    def name(self) -> str:
+        return "%s:%d" % self.address
+
+    # ------------------------------------------------------------- #
+    # flight recorder                                                #
+    # ------------------------------------------------------------- #
+
+    def flight_bundle(self, reason: str) -> dict:
+        """The router's postmortem: event ring + routing state + the
+        trace tail (the router registry has no ``summary()``; replica
+        states carry the equivalent signal)."""
+        return self.flight.dump(
+            reason, tracer=self.tracer,
+            extra={"router": self.name,
+                   "replicas": self.replica_states()})
+
+    def _dump_flight(self, reason: str) -> None:
+        if not self.flight_dir:
+            return
+        try:
+            path = Path(self.flight_dir) / (
+                "flight-router-%s-%s-%d.json" % (
+                    self.name.replace(":", "-"), reason,
+                    int(time.time() * 1000)))
+            self.flight.dump_to(
+                path, reason, tracer=self.tracer,
+                extra={"router": self.name,
+                       "replicas": self.replica_states()})
+            log_event(_log, "flight_dump", reason=reason,
+                      path=str(path))
+        except Exception as e:
+            log_event(_log, "flight_dump_failed", reason=reason,
+                      error=repr(e), level=logging.ERROR)
+
     def start(self) -> "ReplicaRouter":
         self._http_thread.start()
         self._health_thread.start()
@@ -475,10 +619,22 @@ class ReplicaRouter:
             self._health_thread.join(timeout=5)
 
     def serve_forever(self) -> None:
-        """Blocking convenience for the CLI; Ctrl-C stops."""
+        """Blocking convenience for the CLI; Ctrl-C stops, SIGTERM
+        dumps a flight bundle first (the orchestrator's kill is
+        exactly when the postmortem is wanted), then stops."""
         self.start()
+        done = threading.Event()
+
+        def _on_sigterm(signum, frame):
+            self._dump_flight("sigterm")
+            done.set()
+
         try:
-            while True:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded use)
+        try:
+            while not done.is_set():
                 time.sleep(1)
         except KeyboardInterrupt:
             pass

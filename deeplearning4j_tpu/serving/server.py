@@ -91,13 +91,17 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
+import signal
 import threading
 import time
 from http.server import ThreadingHTTPServer
+from pathlib import Path
 from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.obs.logs import log_event
+from deeplearning4j_tpu.obs.trace import new_trace_id, parse_traceparent
 from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.scheduler import (
     AdmissionError,
@@ -136,11 +140,20 @@ class ServingServer:
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, request_timeout_s: float = 300.0,
                  max_restarts: int = 5, hang_threshold_s: float = 120.0,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 flight_dir: str | None = None):
         self.engine = engine
         self.request_timeout_s = request_timeout_s
         self.max_restarts = max_restarts
         self.hang_threshold_s = hang_threshold_s
+        # postmortem bundle directory (crash / watchdog / SIGTERM
+        # dumps); DL4J_TPU_FLIGHT_DIR supplies a default for wiring
+        # sites that don't thread the kwarg (the CI chaos lane sets it)
+        self.flight_dir = (
+            flight_dir if flight_dir is not None
+            else os.environ.get("DL4J_TPU_FLIGHT_DIR") or None
+        )
+        self._hang_dumped = False
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._engine_dead = threading.Event()
@@ -197,6 +210,9 @@ class ServingServer:
                     server._handle_generate(self, body, tenant)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # fleet identity: what the access log reports as served_by
+        # when the router's X-Served-By header is absent (direct hits)
+        self.name = "%s:%d" % self._httpd.server_address[:2]
         # named threads: sanitizer reports (and py-spy dumps)
         # attribute races/locks to "engine-loop" vs "http-serve"
         self._engine_thread = threading.Thread(
@@ -258,9 +274,46 @@ class ServingServer:
             )
         elif path == "/metrics.json":
             send_json(handler, 200, self._metrics_payload())
+        elif path == "/debug/dump":
+            send_json(handler, 200, self.flight_bundle("debug_dump"))
         else:
             return False
         return True
+
+    def flight_bundle(self, reason: str) -> dict:
+        """The crash flight recorder's redacted postmortem bundle:
+        recent engine events + metrics snapshot + trace tail (see
+        :mod:`deeplearning4j_tpu.obs.flight`)."""
+        return self.engine.flight.dump(
+            reason,
+            metrics=self.engine.metrics,
+            tracer=self.engine.tracer,
+            extra={"server": self.name, "health": self._health_payload()},
+        )
+
+    def _dump_flight(self, reason: str) -> None:
+        """Best-effort postmortem write to ``flight_dir`` (no-op when
+        unconfigured; never raises — this runs on crash paths)."""
+        if not self.flight_dir:
+            return
+        try:
+            path = Path(self.flight_dir) / (
+                "flight-%s-%s-%d.json"
+                % (self.name.replace(":", "-"), reason,
+                   int(time.time() * 1000))
+            )
+            self.engine.flight.dump_to(
+                path, reason,
+                metrics=self.engine.metrics,
+                tracer=self.engine.tracer,
+                extra={"server": self.name,
+                       "last_error": self._last_error},
+            )
+            log_event(_log, "flight_dump", reason=reason,
+                      path=str(path))
+        except Exception as e:
+            log_event(_log, "flight_dump_failed", reason=reason,
+                      error=repr(e), level=logging.ERROR)
 
     def _handle_profile(self, handler) -> None:
         """``POST /profile?s=N``: arm an XLA capture of the next N
@@ -341,18 +394,49 @@ class ServingServer:
             done=threading.Event(),
         )
 
+    @staticmethod
+    def _resolve_trace(handler, req: Request) -> None:
+        """W3C trace context: adopt the caller's ``traceparent``
+        (trace id + the caller's span as our parent — the router's
+        dispatch span, when routed) or start a fresh trace. Every
+        request gets a trace id, so the access log and the engine's
+        admission span always correlate."""
+        ctx = parse_traceparent(handler.headers.get("traceparent"))
+        if ctx is not None:
+            req.trace_id, req.parent_span_id = ctx
+        else:
+            req.trace_id = new_trace_id()
+
+    def _access_log(self, handler, req, http: int, status: str,
+                    **fields) -> None:
+        """The one structured access-log line per request: resolved
+        trace context, tenant, and which replica served it (the
+        router's ``X-Served-By`` injection names this process in the
+        router's vocabulary; direct hits fall back to host:port)."""
+        log_event(
+            _log, "access", req_id=req.id, http=http, status=status,
+            trace_id=req.trace_id or None,
+            parent_span_id=req.parent_span_id or None,
+            tenant=req.tenant_id or None,
+            served_by=handler.headers.get("X-Served-By") or self.name,
+            **fields,
+        )
+
     def _handle_generate(self, handler, body: dict, tenant) -> None:
         try:
             req = self._parse_request(body, tenant)
         except (AdmissionError, ValueError, TypeError) as e:
             send_json(handler, 400, {"error": str(e)})
             return
+        self._resolve_trace(handler, req)
         try:
             self.engine.submit(req)
         except Backpressure as e:
+            self._access_log(handler, req, 429, "backpressure")
             send_json(handler, 429, {"error": str(e)})
             return
         except AdmissionError as e:
+            self._access_log(handler, req, 400, "admission_error")
             send_json(handler, 400, {"error": str(e)})
             return
         if req.stream is not None:
@@ -363,14 +447,18 @@ class ServingServer:
             # for a client that is about to get a timeout
             req.cancel()
             log_event(_log, "request_completed", req_id=req.id,
-                      http=504, status="timeout")
+                      http=504, status="timeout",
+                      trace_id=req.trace_id or None)
+            self._access_log(handler, req, 504, "timeout")
             send_json(handler, 504, {"error": "generation timed out"})
             return
         if req.status is not RequestStatus.FINISHED:
             code = _STATUS_HTTP.get(req.status, 500)
             self.engine.pop_result(req.id)  # drop partial stream
             log_event(_log, "request_completed", req_id=req.id,
-                      http=code, status=req.status.value)
+                      http=code, status=req.status.value,
+                      trace_id=req.trace_id or None)
+            self._access_log(handler, req, code, req.status.value)
             send_json(handler, code, {
                 "id": req.id,
                 "status": req.status.value,
@@ -378,9 +466,11 @@ class ServingServer:
             })
             return
         toks = self.engine.pop_result(req.id).tolist()
+        n_new = len(toks) - len(req.prompt)
         log_event(_log, "request_completed", req_id=req.id,
-                  http=200, status="finished",
-                  n_tokens=len(toks) - len(req.prompt))
+                  http=200, status="finished", n_tokens=n_new,
+                  trace_id=req.trace_id or None)
+        self._access_log(handler, req, 200, "finished", n_tokens=n_new)
         out = {"id": req.id, "tokens": toks}
         if self._byte_vocab():
             out["text"] = bytes(
@@ -418,7 +508,10 @@ class ServingServer:
                 if remaining <= 0:
                     req.cancel()
                     log_event(_log, "request_completed", req_id=req.id,
-                              http=504, status="timeout", stream=True)
+                              http=504, status="timeout", stream=True,
+                              trace_id=req.trace_id or None)
+                    self._access_log(handler, req, 504, "timeout",
+                                     stream=True)
                     self._sse(handler, {"error": "generation timed out",
                                         "done": True})
                     return
@@ -439,11 +532,17 @@ class ServingServer:
                 final["error"] = req.error
             self._sse(handler, final)
             log_event(_log, "request_completed", req_id=req.id, http=200,
-                      status=req.status.value, n_tokens=n, stream=True)
+                      status=req.status.value, n_tokens=n, stream=True,
+                      trace_id=req.trace_id or None)
+            self._access_log(handler, req, 200, req.status.value,
+                             n_tokens=n, stream=True)
         except (BrokenPipeError, ConnectionResetError):
             req.cancel()
             log_event(_log, "request_completed", req_id=req.id, http=499,
-                      status="client_gone", n_tokens=n, stream=True)
+                      status="client_gone", n_tokens=n, stream=True,
+                      trace_id=req.trace_id or None)
+            self._access_log(handler, req, 499, "client_gone",
+                             n_tokens=n, stream=True)
         finally:
             # the stream already delivered the tokens; drop the stored
             # copy so streaming traffic doesn't grow the results dict
@@ -471,24 +570,35 @@ class ServingServer:
             tenant_id=tenant.tenant_id if tenant is not None else "",
             done=threading.Event(),
         )
+        self._resolve_trace(handler, req)
         try:
             self.engine.submit(req)
         except Backpressure as e:
+            self._access_log(handler, req, 429, "backpressure",
+                             kind="embedding")
             send_json(handler, 429, {"error": str(e)})
             return
         except AdmissionError as e:
+            self._access_log(handler, req, 400, "admission_error",
+                             kind="embedding")
             send_json(handler, 400, {"error": str(e)})
             return
         if not req.done.wait(self.request_timeout_s):
             req.cancel()
             log_event(_log, "request_completed", req_id=req.id,
-                      http=504, status="timeout", kind="embedding")
+                      http=504, status="timeout", kind="embedding",
+                      trace_id=req.trace_id or None)
+            self._access_log(handler, req, 504, "timeout",
+                             kind="embedding")
             send_json(handler, 504, {"error": "embedding timed out"})
             return
         if req.status is not RequestStatus.FINISHED:
             code = _STATUS_HTTP.get(req.status, 500)
             log_event(_log, "request_completed", req_id=req.id,
-                      http=code, status=req.status.value, kind="embedding")
+                      http=code, status=req.status.value, kind="embedding",
+                      trace_id=req.trace_id or None)
+            self._access_log(handler, req, code, req.status.value,
+                             kind="embedding")
             send_json(handler, code, {
                 "id": req.id,
                 "status": req.status.value,
@@ -500,7 +610,10 @@ class ServingServer:
             for w, v in req.result.items()
         }
         log_event(_log, "request_completed", req_id=req.id, http=200,
-                  status="finished", kind="embedding", n_words=len(words))
+                  status="finished", kind="embedding", n_words=len(words),
+                  trace_id=req.trace_id or None)
+        self._access_log(handler, req, 200, "finished", kind="embedding",
+                         n_words=len(words))
         send_json(handler, 200, {
             "id": req.id, "model": req.model, "vectors": vectors,
         })
@@ -530,6 +643,12 @@ class ServingServer:
         hung, beat_age = self._hung()
         if hung:
             alive = False  # wedged-in-device-call counts as not live
+            if not self._hang_dumped:
+                # one-shot postmortem on the first observed watchdog
+                # trip: the wedged loop can't dump itself, so the
+                # health probe that detects it does
+                self._hang_dumped = True
+                self._dump_flight("watchdog_hang")
         return {
             "ok": alive,
             "engine_alive": alive,
@@ -577,6 +696,9 @@ class ServingServer:
             except Exception as e:  # EngineCrash or an engine bug
                 self._last_error = f"{type(e).__name__}: {e}"
                 consecutive += 1
+                # dump BEFORE recover(): recovery rebuilds engine state,
+                # so this is the last look at the crashed configuration
+                self._dump_flight("engine_crash")
                 if consecutive > self.max_restarts:
                     self._die()
                     return
@@ -597,6 +719,7 @@ class ServingServer:
     def _die(self) -> None:
         """Unrecoverable: mark dead and unblock every waiting caller."""
         self._engine_dead.set()
+        self._dump_flight("engine_dead")
         try:
             self.engine.fail_all(f"engine dead: {self._last_error}")
         except Exception:
@@ -655,11 +778,23 @@ class ServingServer:
             self._metrics_httpd.server_close()
 
     def serve_forever(self, drain_s: float = 0.0) -> None:
-        """Blocking convenience for the CLI; Ctrl-C drains for
-        ``drain_s`` seconds before exiting."""
+        """Blocking convenience for the CLI; Ctrl-C and SIGTERM both
+        drain for ``drain_s`` seconds before exiting. SIGTERM (the
+        orchestrator's kill) additionally dumps a flight bundle first —
+        evictions are exactly when you want the postmortem."""
         self.start()
+        done = threading.Event()
+
+        def _on_sigterm(signum, frame):
+            self._dump_flight("sigterm")
+            done.set()
+
         try:
-            while True:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded use); Ctrl-C still works
+        try:
+            while not done.is_set():
                 time.sleep(1)
         except KeyboardInterrupt:
             pass

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Fleet observability smoke: router + 2 demo replicas over real HTTP.
+
+Boots two ``serve --demo`` replica processes and one ``router`` process
+(each exporting its tracer via --trace-out), drives generate requests
+through the router, checks the live observability surfaces
+(``/debug/dump`` flight bundle, per-family ``serve_program_seconds``
+attribution on ``/metrics``), shuts the fleet down, stitches the three
+per-process trace exports with ``trace-merge``, and validates the
+merged document structurally: >= 3 process tracks, every replica
+admission span's ``parent_span_id`` resolving to a router dispatch
+span on a different track, and cross-process flow arrows present.
+
+CI runs this as the fleet lane; it is also a one-command local repro:
+
+    JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+BOOT_TIMEOUT_S = 240  # demo replicas compile their programs first
+
+
+def wait_port_file(path, procs, timeout=BOOT_TIMEOUT_S):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        for p in procs:
+            if p.poll() is not None:
+                raise SystemExit(f"fleet process exited early: {p.args}")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        time.sleep(0.2)
+    raise SystemExit(f"timed out waiting for {path}")
+
+
+def get(addr, path, timeout=30):
+    url = f"http://{addr['host']}:{addr['port']}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def post_generate(addr, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://{addr['host']}:{addr['port']}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="fleet-smoke-")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = []
+    traces = []
+    try:
+        port_files = []
+        for i in range(2):
+            pf = os.path.join(tmp, f"serve{i}.port")
+            trace = os.path.join(tmp, f"serve{i}.trace.json")
+            port_files.append(pf)
+            traces.append(trace)
+            procs.append(subprocess.Popen([
+                sys.executable, "-m", "deeplearning4j_tpu", "serve",
+                "--demo", "--port", "0", "--slots", "2",
+                "--seq-len", "32", "--d-model", "32",
+                "--n-layers", "2", "--n-heads", "4",
+                "--port-file", pf, "--trace-out", trace,
+                "--flight-dir", tmp,
+            ], env=env))
+        addrs = [wait_port_file(pf, procs) for pf in port_files]
+        print(f"replicas up: {addrs}")
+
+        rpf = os.path.join(tmp, "router.port")
+        rtrace = os.path.join(tmp, "router.trace.json")
+        traces.insert(0, rtrace)
+        replica_flags = []
+        for a in addrs:
+            replica_flags += ["--replica", f"{a['host']}:{a['port']}"]
+        procs.append(subprocess.Popen([
+            sys.executable, "-m", "deeplearning4j_tpu", "router",
+            *replica_flags, "--port", "0", "--port-file", rpf,
+            "--trace-out", rtrace, "--flight-dir", tmp,
+        ], env=env))
+        raddr = wait_port_file(rpf, procs)
+        print(f"router up: {raddr}")
+
+        n_requests = 4
+        for i in range(n_requests):
+            status, body = post_generate(
+                raddr, {"prompt": list(range(1, 8 + i)), "max_new": 3})
+            assert status == 200 and body.get("tokens"), body
+        print(f"{n_requests} requests routed OK")
+
+        dump = json.loads(get(raddr, "/debug/dump"))
+        assert any(e["kind"] == "dispatch" for e in dump["events"]), \
+            "router flight recorder saw no dispatches"
+        for a in addrs:
+            rdump = json.loads(get(a, "/debug/dump"))
+            assert rdump["reason"] == "debug_dump", rdump
+        metrics = b"".join(get(a, "/metrics") for a in addrs).decode()
+        assert "serve_program_seconds_total" in metrics, \
+            "no per-family attribution on /metrics"
+        assert "serve_mfu{" in metrics, "no serve_mfu gauges"
+        print("debug dumps + attribution metrics OK")
+    finally:
+        # SIGINT = the CLI's clean path: drain, then export --trace-out
+        for p in reversed(procs):
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), \
+        [(p.args[-1], p.returncode) for p in procs]
+
+    merged_path = os.path.join(tmp, "merged.trace.json")
+    subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu", "trace-merge",
+         *traces, "-o", merged_path],
+        check=True, env=env)
+    with open(merged_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert len(pids) >= 3, f"expected >= 3 process tracks, got {pids}"
+    dispatches = {
+        e["args"]["span_id"]: e for e in evs
+        if e.get("ph") == "X" and e["name"] == "dispatch"
+        and "span_id" in e.get("args", {})
+    }
+    admissions = [
+        e for e in evs
+        if e.get("ph") == "X" and e["name"] == "prefill"
+        and e.get("args", {}).get("parent_span_id")
+    ]
+    assert len(admissions) >= n_requests, \
+        f"only {len(admissions)} admission spans joined the fleet trace"
+    for adm in admissions:
+        parent = dispatches.get(adm["args"]["parent_span_id"])
+        assert parent is not None, f"unresolved parent: {adm}"
+        assert parent["pid"] != adm["pid"], "parent link not cross-process"
+        assert parent["args"]["trace_id"] == adm["args"]["trace_id"]
+    n_flows = sum(1 for e in evs if e.get("ph") == "s")
+    assert n_flows >= n_requests, f"only {n_flows} flow arrows"
+    print(f"merged trace OK: {len(pids)} tracks, "
+          f"{len(admissions)} admission spans all parented to router "
+          f"dispatches, {n_flows} flow arrows -> {merged_path}")
+
+
+if __name__ == "__main__":
+    main()
