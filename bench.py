@@ -80,6 +80,13 @@ per workload — the driver's round record captures all of them:
                   the prefix-affinity router at 0.5 shared-prefix
                   traffic, driven over real HTTP: headlines routed
                   TTFT p50 speedup vs round-robin dispatch
+- ``transformer-decode-serve-disagg`` disaggregated prefill/decode:
+                  the mixed trace (half 8k prompts, half 512) served by
+                  1 prefill + 1 decode behind the fleet controller (KV
+                  segments pushed over the wire, seated zero-prefill)
+                  vs the same engines as two monolithic replicas
+                  behind the router — end-to-end p99 TTFT / p99 TPOT
+                  deltas and transfer bytes/s in-row
 - ``transformer-decode-serve-tenant`` multi-tenant serving: an
                   adversarial flood (one greedy tenant vs three paced)
                   replayed under deficit-round-robin fair scheduling vs
@@ -1469,6 +1476,219 @@ def _bench_decode_serve_router(args, n_requests: int = 32,
     return tok_per_sec, metric, extra
 
 
+def _bench_decode_serve_disagg(args, n_requests: int = 24,
+                               n_slots: int = 4,
+                               mean_interarrival_s: float = 0.05,
+                               long_len: int = 8192,
+                               short_len: int = 512,
+                               new: int = _DECODE_NEW):
+    """Disaggregated prefill/decode vs monolithic replicas on a mixed
+    long-prompt trace: half the requests carry an 8k prompt, half a
+    512-token one, Poisson arrivals. The SAME two engines serve the
+    trace twice — once as monolithic replicas behind the
+    :class:`~.serving.router.ReplicaRouter` (every replica interleaves
+    8k prefills with its decode batches), once as 1 prefill + 1 decode
+    behind the :class:`~.serving.controller.FleetController` (long
+    prompts prefill on the dedicated replica, the KV segment rides the
+    wire to the decode replica and seats via the zero-prefill full-hit
+    path). Per-request TTFT/TPOT are measured END TO END from the
+    ``timing.decode_s`` the response carries: TTFT = request wall -
+    decode_s, so the disagg numbers pay for their prefill leg, the
+    transfer, and the seat — no engine-local accounting tricks. The
+    claim priced: p99 TTFT improves (long prefills stop
+    head-of-line-blocking decode batches) while p99 TPOT does not
+    regress (the decode replica's step loop never yields to an 8k
+    prefill); ``transfer_mb_per_s`` is what the wire costs. The metric
+    value is the disagg fleet's aggregate tok/s."""
+    import http.client
+    import json as _json
+    import threading
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import init_transformer
+    from deeplearning4j_tpu.serving import (
+        FleetController,
+        RequestScheduler,
+        ServingEngine,
+        ServingMetrics,
+        ServingServer,
+    )
+    from deeplearning4j_tpu.serving.router import ReplicaRouter
+
+    cfg, _, p = _decode_bench_cfg(args, batch=1, gqa=True,
+                                  prompt_len=long_len, new=new)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    longs = rng.integers(
+        0, p["vocab"], (n_requests, long_len)).astype(np.int32)
+    shorts = rng.integers(
+        0, p["vocab"], (n_requests, short_len)).astype(np.int32)
+    # room for several wire-seated 8k segments before eviction kicks in
+    cache_tokens = 8 * (long_len + new + 1)
+    threshold = max(short_len + 1, long_len // 2)
+
+    def make_bodies():
+        bodies = []
+        for i in range(n_requests):
+            prompt = (longs[i].tolist() if i % 2 == 0
+                      else shorts[i].tolist())
+            bodies.append({"prompt": prompt, "max_new": new})
+        return bodies
+
+    def post(addr, body):
+        conn = http.client.HTTPConnection(*addr, timeout=600)
+        t0 = time.perf_counter()
+        try:
+            conn.request(
+                "POST", "/v1/generate", body=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            wall = time.perf_counter() - t0
+            if resp.status != 200:
+                return None
+            out = _json.loads(raw)
+            return {
+                "n_new": len(out["tokens"]) - len(body["prompt"]),
+                "wall": wall,
+                "decode_s": out.get("timing", {}).get("decode_s"),
+            }
+        finally:
+            conn.close()
+
+    def make_engine(prefix: bool):
+        return ServingEngine(
+            cfg, params, n_slots=n_slots,
+            temperature=1.0, top_k=40,
+            approx_top_k=not args.exact_top_k,
+            prefix_cache=prefix,
+            prefix_cache_tokens=cache_tokens if prefix else None,
+            scheduler=RequestScheduler(max_queue_depth=2 * n_requests),
+        )
+
+    def reset(engines):
+        for e in engines:
+            if e.prefix_cache is not None:
+                e.prefix_cache.reinit()
+            e.metrics = ServingMetrics()
+            e.metrics.decode_horizon = e.decode_horizon
+
+    def run_trace(front_addr):
+        bodies = make_bodies()
+        results = [None] * n_requests
+        threads = []
+        t0 = time.perf_counter()
+
+        def fire(i, body):
+            results[i] = post(front_addr, body)
+
+        for i, body in enumerate(bodies):
+            delay = arrivals[i] - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=fire, args=(i, body))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert all(r is not None and r["decode_s"] is not None
+                   for r in results), "fleet request failed"
+        ttft = [r["wall"] - r["decode_s"] for r in results]
+        tpot = [r["decode_s"] / (r["n_new"] - 1) for r in results
+                if r["n_new"] > 1]
+        return {
+            "tok_per_sec": sum(r["n_new"] for r in results) / dt,
+            "ttft_p50_s": float(np.percentile(ttft, 50)),
+            "ttft_p99_s": float(np.percentile(ttft, 99)),
+            "tpot_p99_s": float(np.percentile(tpot, 99)),
+        }
+
+    def run_mono():
+        engines = [make_engine(prefix=True) for _ in range(2)]
+        servers = [ServingServer(e, port=0).start() for e in engines]
+        router = ReplicaRouter(
+            [s.address for s in servers],
+            # prompts are unique: pure least-loaded dispatch
+            affinity_min_match=long_len + 1,
+        ).start()
+        try:
+            for body in make_bodies()[:2]:  # compile: one long, one short
+                post(router.address, body)
+            reset(engines)
+            return run_trace(router.address)
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+
+    def run_disagg():
+        pf_eng = make_engine(prefix=False)
+        dc_eng = make_engine(prefix=True)
+        servers = [ServingServer(e, port=0).start()
+                   for e in (pf_eng, dc_eng)]
+        (ph, pp), (dh, dp) = servers[0].address, servers[1].address
+        ctl = FleetController(
+            [(ph, pp, "prefill"), (dh, dp, "decode")],
+            disagg_threshold=threshold,
+            rebalance_enabled=False,  # fixed roles: this row prices them
+        ).start()
+        try:
+            ctl.poll_health()
+            for body in make_bodies()[:2]:  # compile both legs
+                post(ctl.address, body)
+            reset((pf_eng, dc_eng))
+            out = run_trace(ctl.address)
+            dsum = pf_eng.metrics.summary().get("disagg", {})
+            out["transfers"] = dsum.get("transfers", 0)
+            out["transfer_failures"] = dsum.get("transfer_failures", 0)
+            out["transfer_bytes"] = dsum.get("transfer_bytes", 0)
+            out["transfer_bytes_per_s"] = dsum.get("transfer_bytes_per_s")
+            ddis = dc_eng.metrics.summary().get("disagg", {})
+            out["kv_ingests_declined"] = ddis.get("kv_ingests_declined", 0)
+            return out
+        finally:
+            ctl.stop()
+            for s in servers:
+                s.stop()
+
+    mono = run_mono()
+    dis = run_disagg()
+    assert dis["transfers"] >= 1, "no KV transfer in the timed window"
+    tok_per_sec = dis["tok_per_sec"]
+    extra = {
+        "ttft_p50_s": round(dis["ttft_p50_s"], 4),
+        "ttft_p99_s": round(dis["ttft_p99_s"], 4),
+        "tpot_p99_s": round(dis["tpot_p99_s"], 5),
+        "mono_ttft_p99_s": round(mono["ttft_p99_s"], 4),
+        "mono_tpot_p99_s": round(mono["tpot_p99_s"], 5),
+        "ttft_p99_speedup": round(
+            mono["ttft_p99_s"] / max(dis["ttft_p99_s"], 1e-9), 3),
+        "tpot_p99_ratio": round(
+            dis["tpot_p99_s"] / max(mono["tpot_p99_s"], 1e-9), 3),
+        "mono_tok_per_sec": round(mono["tok_per_sec"], 1),
+        "transfers": dis["transfers"],
+        "transfer_failures": dis["transfer_failures"],
+        "transfer_bytes": dis["transfer_bytes"],
+        "transfer_mb_per_s": (
+            round(dis["transfer_bytes_per_s"] / 1e6, 1)
+            if dis["transfer_bytes_per_s"] else None),
+        "kv_ingests_declined": dis["kv_ingests_declined"],
+        "long_prompt_len": long_len,
+        "short_prompt_len": short_len,
+        "long_frac": 0.5,
+        "disagg_threshold": threshold,
+        "n_requests": n_requests,
+        "n_slots": n_slots,
+    }
+    metric = ("transformer_gpt2s_h128_decode_serve_disagg_"
+              "tokens_per_sec_per_chip")
+    return tok_per_sec, metric, extra
+
+
 def _bench_decode_serve_tenant(args, n_slots: int = 4,
                                n_flood: int = 16, n_victims: int = 3,
                                reqs_per_victim: int = 1,
@@ -1791,6 +2011,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-serve", "transformer-decode-serve-faults",
     "transformer-decode-serve-prefix", "transformer-decode-serve-paged",
     "transformer-decode-serve-tp", "transformer-decode-serve-router",
+    "transformer-decode-serve-disagg",
     "transformer-decode-serve-tenant",
 )
 
@@ -1819,6 +2040,7 @@ _AUTO_DTYPE = {
     "transformer-decode-serve-paged": "bf16",
     "transformer-decode-serve-tp": "bf16",
     "transformer-decode-serve-router": "bf16",
+    "transformer-decode-serve-disagg": "bf16",
     "transformer-decode-serve-tenant": "bf16",
 }
 
@@ -1951,6 +2173,12 @@ def _run_one_inner(args, jax) -> None:
             _report(args, per_chip, metric, jax, extra=extra,
                     remeasure=lambda: (
                         _bench_decode_serve_router(args)[0], None))
+            return
+        if args.model == "transformer-decode-serve-disagg":
+            per_chip, metric, extra = _bench_decode_serve_disagg(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (
+                        _bench_decode_serve_disagg(args)[0], None))
             return
         if args.model == "transformer-decode-serve-tenant":
             per_chip, metric, extra = _bench_decode_serve_tenant(args)

@@ -47,9 +47,11 @@ from deeplearning4j_tpu.serving.engine import (
     build_paged_insert_program,
     build_paged_prefill_program,
     build_paged_seg_fetch_program,
+    build_paged_seg_import_program,
     build_prefill_program,
     build_replay_program,
     build_seg_fetch_program,
+    build_seg_import_program,
     build_seg_store_program,
     build_step_program,
 )
@@ -351,6 +353,14 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
                 (av.region, av.caches, _i32(), _i32()),
             ),
         )
+    if want("seg_import"):
+        add(
+            "seg_import", "seg_import",
+            lambda: (
+                build_seg_import_program(),
+                (av.region, av.scratch, _i32()),
+            ),
+        )
     if want("logit_row"):
         add(
             "logit_row", "logit_row",
@@ -411,6 +421,14 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
             lambda: (
                 build_paged_seg_fetch_program(),
                 (av.blocks, av.seg_row),
+            ),
+        )
+    if geom.paged and want("paged_seg_import"):
+        add(
+            "paged_seg_import", "paged_seg_import",
+            lambda: (
+                build_paged_seg_import_program(),
+                (av.blocks, av.seg_row, av.scratch),
             ),
         )
     if geom.paged and want("block_copy"):
@@ -514,12 +532,12 @@ def expected_surface(
 
     singletons = {
         "replay", "deactivate", "insert", "hit_insert",
-        "seg_fetch", "seg_store", "logit_row",
+        "seg_fetch", "seg_store", "seg_import", "logit_row",
     }
     if geom.paged:
         singletons |= {
             "paged_replay", "paged_insert", "paged_seg_fetch",
-            "block_copy",
+            "paged_seg_import", "block_copy",
         }
     return {
         "step": set(geom.horizons()),
@@ -550,10 +568,13 @@ def live_engine_families(engine) -> dict[str, set]:
         ("hit_insert", engine._hit_insert_fn),
         ("seg_fetch", engine._seg_fetch_fn),
         ("seg_store", engine._seg_store_fn),
+        ("seg_import", engine._seg_import_fn),
         ("logit_row", engine._logit_row_fn),
         ("paged_insert", getattr(engine, "_paged_insert_fn", None)),
         ("paged_seg_fetch",
          getattr(engine, "_paged_seg_fetch_fn", None)),
+        ("paged_seg_import",
+         getattr(engine, "_paged_seg_import_fn", None)),
         ("block_copy", getattr(engine, "_block_copy_fn", None)),
     ):
         if fn is not None:

@@ -840,6 +840,91 @@ def cmd_router(args) -> int:
     return 0
 
 
+def cmd_controller(args) -> int:
+    """Run the disaggregated-fleet controller in front of N running
+    `serve` processes with roles: prompts of --disagg-threshold tokens
+    or more prefill on a prefill replica, whose KV segment is pushed
+    replica-to-replica to the decode target; everything else (and
+    every transfer failure) prefills locally on the decode replica.
+    Session-sticky + shadow-affinity routing, hysteretic role
+    rebalancing, /fleet/drain rolling restarts. See
+    serving/controller.py."""
+    from deeplearning4j_tpu.obs import Tracer, configure_json_logging
+    from deeplearning4j_tpu.serving.controller import (
+        FleetController,
+        RoleBalancer,
+    )
+
+    if args.log_json:
+        configure_json_logging()
+    tracer = Tracer(
+        enabled=args.trace_out is not None,
+        capacity=args.trace_capacity,
+        process_name="controller",
+    )
+    sans = None
+    if args.sanitize:
+        from deeplearning4j_tpu.analysis.sanitizers import (
+            LockSanitizer,
+            SyncSanitizer,
+        )
+
+        # install BEFORE the controller builds its locks: wrap_lock
+        # only instruments locks created while a sanitizer is active
+        sans = (LockSanitizer().install(), SyncSanitizer().install())
+        print("sanitizers: lock + sync active (development mode)")
+    try:
+        controller = FleetController(
+            args.replica,
+            host=args.host, port=args.port,
+            disagg_threshold=args.disagg_threshold,
+            affinity_min_match=args.affinity_min_match,
+            health_interval_s=args.health_interval,
+            request_timeout_s=args.request_timeout,
+            rebalance=RoleBalancer(
+                threshold=args.rebalance_threshold,
+                windows=args.rebalance_windows,
+                dwell_s=args.rebalance_dwell,
+            ),
+            rebalance_enabled=not args.no_rebalance,
+            tracer=tracer,
+            flight_dir=args.flight_dir,
+        )
+    except ValueError as e:
+        print(f"controller: {e}", file=sys.stderr)
+        return 2
+    host, port = controller.address
+    tracer.process_name = f"controller {host}:{port}"
+    roles = ", ".join(f"{m.name}={m.role}" for m in controller.members)
+    print(f"fleet control on http://{host}:{port} -> [{roles}]  "
+          f"(disagg >= {args.disagg_threshold} tokens, "
+          f"health poll {args.health_interval:g}s)")
+    try:
+        if args.port_file:
+            controller.start()
+            tmp = f"{args.port_file}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"host": host, "port": port}, f)
+            os.replace(tmp, args.port_file)
+            try:
+                while True:
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                controller.stop()
+        else:
+            controller.serve_forever()
+    finally:
+        if args.trace_out:
+            out = tracer.export(args.trace_out)
+            print(f"trace: {tracer.n_events} events "
+                  f"({tracer.dropped} dropped) -> {out}")
+    if sans is not None:
+        return _report_sanitizers(None, *sans)
+    return 0
+
+
 def cmd_trace_merge(args) -> int:
     """Stitch per-process Chrome-trace exports (each written by a
     serve/router --trace-out) into one Perfetto document: one process
@@ -1235,6 +1320,67 @@ def main(argv: list[str] | None = None) -> int:
                    "threads and exit nonzero at shutdown if any "
                    "violation was recorded")
     r.set_defaults(fn=cmd_router)
+
+    c = sub.add_parser(
+        "controller",
+        help="disaggregated-fleet controller over N serve replicas "
+        "with prefill/decode roles (KV-segment transfer for long "
+        "prompts, session stickiness, hysteretic role rebalancing, "
+        "rolling-restart draining)",
+    )
+    c.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT[=ROLE]",
+                   help="one backend serve address with an optional "
+                   "role (prefill|decode|monolithic, default "
+                   "monolithic); repeat per replica")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, default=8000)
+    c.add_argument("--disagg-threshold", type=int, default=64,
+                   metavar="N",
+                   help="prompt length (tokens) at which a request "
+                   "takes the prefill->transfer->decode path; below "
+                   "it the wire transfer costs more than the prefill "
+                   "it moves (see PERF.md for the heuristic)")
+    c.add_argument("--affinity-min-match", type=int, default=8,
+                   help="shared-prefix tokens before shadow affinity "
+                   "overrides least-loaded decode dispatch")
+    c.add_argument("--health-interval", type=float, default=0.5,
+                   help="seconds between health/SLO polls of each "
+                   "replica (also the rebalance sampling cadence)")
+    c.add_argument("--request-timeout", type=float, default=300.0)
+    c.add_argument("--rebalance-threshold", type=float, default=2.0,
+                   help="pressure ratio (queue depth + SLO burn) one "
+                   "role pool must exceed over the other before a "
+                   "role flip is considered")
+    c.add_argument("--rebalance-windows", type=int, default=3,
+                   help="consecutive imbalanced samples required "
+                   "before flipping a role (hysteresis)")
+    c.add_argument("--rebalance-dwell", type=float, default=30.0,
+                   help="minimum seconds between role flips")
+    c.add_argument("--no-rebalance", action="store_true",
+                   help="disable automatic role rebalancing (roles "
+                   "still movable via POST /fleet/role)")
+    c.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="enable the controller's dispatch tracer and "
+                   "write its Chrome-trace/Perfetto JSON to PATH on "
+                   "shutdown (merge with replica traces via "
+                   "trace-merge)")
+    c.add_argument("--trace-capacity", type=int, default=1 << 16,
+                   help="tracer ring-buffer size in events")
+    c.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="write the controller's flight-recorder "
+                   "bundle to DIR on SIGTERM; also honours "
+                   "DL4J_TPU_FLIGHT_DIR. GET /debug/dump serves the "
+                   "live bundle regardless")
+    c.add_argument("--log-json", action="store_true")
+    c.add_argument("--port-file", default=None, metavar="PATH",
+                   help="write the bound address as JSON to PATH once "
+                   "listening (for harnesses using --port 0)")
+    c.add_argument("--sanitize", action="store_true",
+                   help="development mode: runtime sanitizers on the "
+                   "controller's own threads; exit nonzero at "
+                   "shutdown if any violation was recorded")
+    c.set_defaults(fn=cmd_controller)
 
     m = sub.add_parser(
         "trace-merge",

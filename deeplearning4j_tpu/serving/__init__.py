@@ -34,6 +34,13 @@ Public surface:
   front end over N engine replicas: prefix-affinity dispatch via a
   shadow token trie, least-loaded otherwise, per-replica health with
   retry onto survivors (``router`` subcommand).
+- :class:`~deeplearning4j_tpu.serving.controller.FleetController` —
+  disaggregated prefill/decode fleet control: role assignment with
+  hysteretic rebalancing (:class:`~deeplearning4j_tpu.serving.controller.RoleBalancer`),
+  long prompts prefilled on prefill replicas whose KV segments ship
+  replica-to-replica over the :mod:`~deeplearning4j_tpu.serving.disagg`
+  wire format, session-sticky routing, and rolling-restart draining
+  (``controller`` subcommand).
 - :class:`~deeplearning4j_tpu.serving.tenancy.TenantRegistry` /
   :class:`~deeplearning4j_tpu.serving.tenancy.TenantConfig` —
   multi-tenant serving: API-key resolution, per-tenant priority /
@@ -46,6 +53,17 @@ Public surface:
 from deeplearning4j_tpu.serving.cache_pool import (  # noqa: F401
     KVSlotPool,
     PagedKVPool,
+)
+from deeplearning4j_tpu.serving.controller import (  # noqa: F401
+    FleetController,
+    RoleBalancer,
+)
+from deeplearning4j_tpu.serving.disagg import (  # noqa: F401
+    WIRE_VERSION,
+    WireError,
+    decode_segment,
+    encode_segment,
+    model_config_hash,
 )
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
@@ -64,6 +82,8 @@ from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Backpressure,
     EmbeddingRequest,
+    KVExportRequest,
+    KVIngestRequest,
     Request,
     RequestScheduler,
     RequestStatus,

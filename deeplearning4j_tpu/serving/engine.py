@@ -151,6 +151,13 @@ from deeplearning4j_tpu.obs.trace import (
     slot_track,
 )
 from deeplearning4j_tpu.serving.cache_pool import KVSlotPool, PagedKVPool
+from deeplearning4j_tpu.serving.disagg import (
+    WireError,
+    decode_segment,
+    encode_segment,
+    model_config_hash,
+    slab_to_blocks,
+)
 from deeplearning4j_tpu.serving.faults import (
     EngineCrash,
     FaultInjector,
@@ -197,6 +204,8 @@ PROGRAM_DONATION: dict[str, tuple[int, ...]] = {
     "batch_hit": (0, 1, 2, 3, 4, 5),
     # segment store replaces the region functionally
     "seg_store": (0,),
+    # wire-segment import lands a host-uploaded slab the same way
+    "seg_import": (0,),
     # pure reads
     "chunk": (),
     "seg_fetch": (),
@@ -211,6 +220,7 @@ PROGRAM_DONATION: dict[str, tuple[int, ...]] = {
     "paged_insert": (0, 1, 2, 3, 4, 5),
     "block_copy": (0,),
     "paged_seg_fetch": (),
+    "paged_seg_import": (0,),
 }
 
 
@@ -442,6 +452,24 @@ def build_seg_store_program():
     return store
 
 
+def build_seg_import_program():
+    """Wire-segment import: land a batch-1 slab (a remote replica's
+    ``_seg_fetch``-layout segment, uploaded from host bytes) into the
+    region at the segment index — the disaggregated-ingest mirror of
+    the segment store, with the pool slot slice replaced by the slab
+    that arrived over the wire."""
+
+    def imp(region, slab, seg):
+        return jax.tree.map(
+            lambda r, t: lax.dynamic_update_slice(
+                r, t, (0, 0, seg, 0, 0)
+            ),
+            region, slab,
+        )
+
+    return imp
+
+
 def build_logit_row_program():
     """(1, V) row slice of the pending logits — captured at insert
     time so a later FULL hit replays the exact prefill logits without
@@ -601,6 +629,19 @@ def build_paged_seg_fetch_program():
         return paged_slot_gather(blocks, seg_row)
 
     return fetch
+
+
+def build_paged_seg_import_program():
+    """Paged wire-segment import: scatter a host-uploaded batch-1 slab
+    into the segment's freshly allocated blocks through a sentinel-
+    padded table row (rows past the segment's block span land in the
+    sentinel block and vanish, as everywhere else in the paged
+    layout)."""
+
+    def imp(blocks, seg_row, slab):
+        return paged_slot_scatter(blocks, seg_row, slab)
+
+    return imp
 
 
 def build_block_copy_program():
@@ -910,6 +951,10 @@ class ServingEngine:
         # program; hoisting it out of the per-step program keeps every
         # step from re-casting — same values, cast is deterministic)
         self._cfg_key = cfg.to_json()
+        # the model-config identity KV segments are keyed by on the
+        # wire and in the prefix cache: a segment computed under a
+        # different config hash must never be seated here
+        self.config_hash = model_config_hash(cfg)
         self.params = _shared_program(
             (self._cfg_key, self.tp, "cast_params"),
             lambda: jax.jit(cast_params),
@@ -1026,6 +1071,7 @@ class ServingEngine:
                 # can never serve a hit (partial matches round down;
                 # block-aligned under paging)
                 min_seg_len=self._hit_grain,
+                config_hash=self.config_hash,
             )
         self._register_gauges()
 
@@ -1074,6 +1120,7 @@ class ServingEngine:
         self._chunked_ok: bool | None = None  # replay parity probe memo
         self._prefix_ok_memo: bool | None = None  # hit-path parity memo
         self._batch_ok_memo: bool | None = None   # batched-path memo
+        self._disagg_ok_memo: bool | None = None  # wire seat-path memo
         self.last_recover_mode: str | None = None
         # programs that COMPUTE prompt rows (bucketed prefill, chunk
         # windows, batched prefill groups) — a pure-copy admission
@@ -1130,6 +1177,7 @@ class ServingEngine:
         self._hit_insert_fn = None
         self._seg_store_fn = None
         self._seg_fetch_fn = None
+        self._seg_import_fn = None
         self._logit_row_fn = None
         self._admit_donate = self._donate("prefill")
         # paged program caches. The SLAB prefill/insert/chunk caches
@@ -1140,6 +1188,7 @@ class ServingEngine:
         self._paged_prefill_fns: dict[int, object] = {}
         self._paged_insert_fn = None
         self._paged_seg_fetch_fn = None
+        self._paged_seg_import_fn = None
         self._block_copy_fn = None
         self._paged_admit_donate = self._donate("paged_prefill")
         # arm attribution last: everything dispatched above was a probe
@@ -1394,6 +1443,19 @@ class ServingEngine:
             )
         return self._seg_store_fn
 
+    def _seg_import(self):
+        """Jitted wire-segment import (see
+        :func:`build_seg_import_program`)."""
+        if self._seg_import_fn is None:
+            self._seg_import_fn = _shared_program(
+                self._prog_key + ("seg_import",),
+                lambda: jax.jit(
+                    build_seg_import_program(),
+                    donate_argnums=self._donate("seg_import"),
+                ),
+            )
+        return self._seg_import_fn
+
     def _logit_row(self):
         """Jitted (1, V) pending-logits row slice (see
         :func:`build_logit_row_program`)."""
@@ -1444,6 +1506,19 @@ class ServingEngine:
                 lambda: jax.jit(build_paged_seg_fetch_program()),
             )
         return self._paged_seg_fetch_fn
+
+    def _paged_seg_import(self):
+        """Jitted paged wire-segment import (see
+        :func:`build_paged_seg_import_program`)."""
+        if self._paged_seg_import_fn is None:
+            self._paged_seg_import_fn = _shared_program(
+                self._prog_key + ("paged_seg_import",),
+                lambda: jax.jit(
+                    build_paged_seg_import_program(),
+                    donate_argnums=self._donate("paged_seg_import"),
+                ),
+            )
+        return self._paged_seg_import_fn
 
     def _block_copy(self):
         """Jitted single-block copy (see
@@ -1652,11 +1727,22 @@ class ServingEngine:
         req.error = error
         self._store_result(req, st.tokens)
         if status is RequestStatus.FINISHED:
+            decode_s = now - (st.t_first_token or now)
             self.metrics.record_finished(
-                req.id, len(st.tokens),
-                now - (st.t_first_token or now),
-                tenant=req.tenant_id,
+                req.id, len(st.tokens), decode_s, tenant=req.tenant_id,
             )
+            if (req.kind == "generate" and st.t_first_token is not None
+                    and req.arrival_time is not None):
+                # engine-measured request timing, surfaced in the HTTP
+                # response: ttft_s is engine-local (scheduler arrival to
+                # first token — excludes any upstream prefill/transfer
+                # leg), decode_s is the wall time after the first token,
+                # which lets a client recover true end-to-end TTFT as
+                # (request wall - decode_s) without streaming
+                req.timing = {
+                    "ttft_s": st.t_first_token - req.arrival_time,
+                    "decode_s": decode_s,
+                }
         else:
             self.metrics.record_outcome(status, tenant=req.tenant_id)
         self.pool.release(slot)
@@ -1737,6 +1823,262 @@ class ServingEngine:
                   kind="embedding")
         if req.done is not None:
             req.done.set()
+
+    # -- disaggregated prefill/decode --------------------------------------
+    #
+    # A PREFILL replica serves KVExportRequests: prefill the prompt
+    # into a transiently held pool slot through the SAME bucketed
+    # admission programs a monolithic admission dispatches — which is
+    # what makes the transfer byte-exact by construction — snapshot the
+    # segment slab plus the pending logits row to host, and release the
+    # slot without decoding. A DECODE replica serves KVIngestRequests:
+    # validate the wire-decoded slab against its own cache geometry,
+    # land it in the prefix cache (region import in slab mode, private
+    # block scatter in paged mode), and let the follow-up generate
+    # full-hit — zero prefill dispatched for the covered prompt. Both
+    # paths are gated by the disagg parity probe (_disagg_ok), and
+    # every ingest decline is SOFT: the sender falls back to local
+    # prefill, which is byte-identical anyway.
+
+    def _serve_kv_export(self, req, now: float) -> None:
+        """Serve a :class:`KVExportRequest` at the admission boundary.
+        ``req.result`` gets the raw segment material (host arrays +
+        layout metadata) ready for
+        :func:`~deeplearning4j_tpu.serving.disagg.encode_segment` —
+        framing happens on the HTTP thread, off the engine loop."""
+        t0 = time.perf_counter()
+        seq = np.asarray(req.prompt, np.int32)
+        n = int(len(seq))
+        if not self._disagg_ok():
+            self._retire_unadmitted(
+                req, RequestStatus.FAILED,
+                "disagg wire parity probe failed on this backend",
+            )
+            return
+        if n + 1 > self.max_total or n > self.pool.tpad:
+            self._retire_unadmitted(
+                req, RequestStatus.FAILED,
+                f"prompt of {n} tokens cannot be exported "
+                f"(max_total={self.max_total}, tpad={self.pool.tpad})",
+            )
+            return
+        slot = self.pool.acquire()
+        try:
+            self._prefill_seq_into_slot(seq, slot, 1, _NO_EOS,
+                                        adapter=req.adapter)
+            if self._paged:
+                slab = self._paged_seg_fetch()(
+                    self.pool.caches,
+                    jnp.asarray(self.pool.table(slot)),
+                )
+            else:
+                # a 1-slot region IS the batch-1 slab every seat path
+                # consumes; seg_store copies the pool slot into it
+                slab = self._seg_store()(
+                    self.pool.alloc_region(1), self.pool.caches,
+                    jnp.int32(0), jnp.int32(slot),
+                )
+            leaves = [
+                np.asarray(leaf)  # lint: sync-ok wire export copies the segment to host by design
+                for leaf in jax.tree.leaves(slab)
+            ]
+            lg = np.asarray(  # lint: sync-ok pending logits row rides the wire frame
+                self._logit_row()(self._logits, jnp.int32(slot))
+            )
+        except BaseException:
+            # EngineCrash (or anything unexpected): the popped request
+            # must not be dropped — requeue it before the supervisor
+            # rebuilds state, exactly like an unseated admission plan.
+            self.pool.release(slot)
+            self.scheduler.requeue(req)
+            raise
+        # the slot was only a prefill staging area: clear its device
+        # active bit (prefill armed it with budget 1) and free it
+        self._dactive = self._deact_fn(self._dactive, jnp.int32(slot))
+        self.pool.release(slot)
+        req.result = {
+            "config_hash": self.config_hash,
+            "tokens": seq,
+            "leaves": (slab_to_blocks(leaves, self._block_size)
+                       if self._paged else leaves),
+            "logits": lg,
+            "layout": "paged" if self._paged else "slab",
+            "block_size": self._block_size if self._paged else 0,
+        }
+        req.status = RequestStatus.FINISHED
+        nbytes = sum(a.nbytes for a in leaves) + lg.nbytes
+        self.metrics.record_kv_export(
+            n, nbytes, time.perf_counter() - t0, tenant=req.tenant_id,
+        )
+        # a real admission span (named "prefill", prefix="export") so
+        # the merged fleet trace chains controller dispatch -> export
+        # prefill -> transfer -> decode ingest; the span id rides the
+        # result so the HTTP layer parents its transfer span on it
+        tctx = {}
+        if self.tracer.enabled and req.trace_id:
+            tctx = {"trace_id": req.trace_id, "span_id": new_span_id()}
+            if req.parent_span_id:
+                tctx["parent_span_id"] = req.parent_span_id
+            req.result["span_id"] = tctx["span_id"]
+        self.tracer.span(
+            SCHEDULER_TRACK, "prefill", t0, time.perf_counter() - t0,
+            req_id=req.id, prompt_len=n, prefix="export",
+            nbytes=nbytes, **tctx,
+        )
+        log_event(_log, "request_retired", req_id=req.id, slot=None,
+                  status=req.status.value, n_tokens=n, error=None,
+                  tenant=req.tenant_id or None, kind="kv_export")
+        if req.done is not None:
+            req.done.set()
+
+    def _serve_kv_ingest(self, req, now: float) -> None:
+        """Seat a wire-delivered KV segment (req.segment: a
+        :func:`~deeplearning4j_tpu.serving.disagg.decode_segment`
+        dict) in the prefix cache so the follow-up generate request
+        full-hits. Slotless and SOFT-failing: every decline reports
+        ``{"stored": False, "reason": ...}`` and the sender falls back
+        to local prefill — byte-identical by the parity bar, so a
+        decline costs latency, never correctness."""
+        t0 = time.perf_counter()
+        seg_data = req.segment
+        tokens = np.asarray(seg_data["tokens"], np.int32)
+        n = int(len(tokens))
+        cache = self.prefix_cache
+        reason = None
+        if cache is None:
+            reason = "no prefix cache on this replica"
+        elif seg_data.get("config_hash") != self.config_hash:
+            reason = "model config hash mismatch"
+        elif n < self._hit_grain or n > self.pool.tpad:
+            reason = (f"segment of {n} tokens not seatable "
+                      f"(grain={self._hit_grain}, tpad={self.pool.tpad})")
+        elif not (self._prefix_reuse_ok() and self._disagg_ok()):
+            reason = "parity probes reject wire seating on this backend"
+        stored = False
+        if reason is None:
+            try:
+                slab = self._wire_slab(seg_data)
+            except WireError as e:
+                reason = str(e)
+            else:
+                stored, reason = self._seat_wire_segment(
+                    tokens, slab, seg_data["logits"]
+                )
+        req.result = {"stored": stored, "reason": reason, "n_tokens": n}
+        req.status = RequestStatus.FINISHED
+        self.metrics.record_kv_ingest(
+            n, int(seg_data.get("nbytes", 0)),
+            time.perf_counter() - t0, stored=stored,
+            tenant=req.tenant_id,
+        )
+        tctx = {}
+        if self.tracer.enabled and req.trace_id:
+            tctx = {"trace_id": req.trace_id, "span_id": new_span_id()}
+            if req.parent_span_id:
+                tctx["parent_span_id"] = req.parent_span_id
+        self.tracer.span(
+            SCHEDULER_TRACK, "kv_ingest", t0,
+            time.perf_counter() - t0, req_id=req.id,
+            n_tokens=n, stored=stored, **tctx,
+        )
+        log_event(_log, "request_retired", req_id=req.id, slot=None,
+                  status=req.status.value, n_tokens=n,
+                  error=None if stored else reason,
+                  tenant=req.tenant_id or None, kind="kv_ingest")
+        if req.done is not None:
+            req.done.set()
+
+    def _wire_slab(self, seg_data: dict):
+        """Validate a decoded frame's slab leaves against this
+        engine's cache geometry and upload them as the batch-1 device
+        pytree every seat path consumes. Raises :class:`WireError`
+        (status 400) on any disagreement — geometry is derived from
+        the config, so after the hash check a mismatch here means a
+        corrupt or hand-rolled frame, not version skew."""
+        shapes = jax.eval_shape(
+            lambda: self._init_caches(1, self.max_total)
+        )
+        specs = jax.tree.leaves(shapes)
+        leaves = seg_data["leaves"]
+        if len(leaves) != len(specs):
+            raise WireError(
+                f"frame has {len(leaves)} cache leaves, engine "
+                f"expects {len(specs)}"
+            )
+        up = []
+        for i, (arr, spec) in enumerate(zip(leaves, specs)):
+            if (tuple(arr.shape) != tuple(spec.shape)
+                    or arr.dtype != spec.dtype):
+                raise WireError(
+                    f"leaf {i} is {arr.dtype.name}{tuple(arr.shape)}, "
+                    f"engine expects "
+                    f"{np.dtype(spec.dtype).name}{tuple(spec.shape)}"
+                )
+            up.append(jnp.asarray(arr))
+        lg = seg_data["logits"]
+        if (tuple(lg.shape) != (1, self.cfg.vocab_size)
+                or lg.dtype != np.float32):
+            raise WireError(
+                f"logits are {lg.dtype.name}{tuple(lg.shape)}, engine "
+                f"expects float32(1, {self.cfg.vocab_size})"
+            )
+        return jax.tree.unflatten(jax.tree.structure(shapes), up)
+
+    def _seat_wire_segment(self, tokens: np.ndarray, slab,
+                           logits_row) -> tuple[bool, str | None]:
+        """Insert ``tokens`` in the prefix cache and back every new
+        segment with the wire slab's rows. Returns ``(stored,
+        reason)`` where ``stored`` means the follow-up generate will
+        FULL-hit (full-length segment seated with its logits row)."""
+        cache = self.prefix_cache
+        n = int(len(tokens))
+        seg, matched = cache.lookup(tokens)
+        if seg is not None and matched == n and seg.logits is not None:
+            return True, "already cached"
+        segs = cache.insert(tokens)
+        if not segs:
+            return False, "cache declined (all segments pinned)"
+        stored = False
+        for seg in segs:
+            if self._paged:
+                if not self._back_paged_wire_segment(seg, slab):
+                    # block allocation lost to admission pressure:
+                    # un-cache rather than leave an unbacked segment
+                    cache.drop(seg)
+                    continue
+            else:
+                cache.region = self._seg_import()(
+                    cache.region, slab, jnp.int32(seg.slot)
+                )
+            if seg.length == n:
+                seg.logits = jnp.asarray(logits_row)
+                stored = True
+            self.metrics.record_prefix_insert()
+            self.tracer.instant(
+                ENGINE_TRACK, "prefix_insert", source="wire",
+                length=seg.length,
+            )
+            cache.unpin(seg)
+        return stored, None if stored else "segment backing failed"
+
+    def _back_paged_wire_segment(self, seg, slab) -> bool:
+        """Back one paged wire segment with freshly allocated private
+        blocks holding the slab's rows — there is no donor slot to
+        alias; the prefill happened on another replica. Rows past the
+        segment's block span scatter to the sentinel block and vanish.
+        False when the allocation loses to admission pressure."""
+        need = self.pool.blocks_needed(seg.length)
+        try:
+            ids = self.pool.alloc_blocks(need)
+        except RuntimeError:
+            return False
+        row = np.zeros((self.pool.blocks_per_slot,), np.int32)
+        row[:need] = ids
+        self.pool.caches = self._paged_seg_import()(
+            self.pool.caches, jnp.asarray(row), slab
+        )
+        seg.block_ids = ids
+        return True
 
     def _slot_of(self, req_id: str | None) -> int | None:
         if req_id is None:
@@ -2313,6 +2655,110 @@ class ServingEngine:
                                 ok=self._prefix_ok_memo)
         return self._prefix_ok_memo
 
+    def _probe_disagg_parity(self) -> bool:
+        """One-time probe gating the disaggregated wire path: does a
+        segment moved prefill -> seg_store -> host wire frame (a real
+        ``encode_segment``/``decode_segment`` byte round-trip) ->
+        device import -> zero-prefill hit insert reproduce, bitwise,
+        the KV rows AND logits of the direct prefill? Paged engines
+        additionally push the slab through the block scatter/gather
+        pair ingest uses. On refusal both export and ingest decline
+        and the fleet falls back to local prefill everywhere."""
+        n = min(self._min_bucket + 3, self.max_total - 1,
+                self.pool.tpad)
+        if n < 1:
+            return False
+        _disp = self.prefill_dispatches  # probes don't count
+        self._attr_suspend += 1  # nor toward device-time attribution
+        try:
+            seq = ((1 + np.arange(n)) % self.cfg.vocab_size).astype(
+                np.int32
+            )
+            sa = self._prefill_into_state(
+                self._scratch_state(), seq, 0, 1, _NO_EOS
+            )
+            rows_a = self._slot_rows(sa[0], 0, n)
+            lg_a = np.asarray(sa[1][0])
+            # export side: slab snapshot + pending logits row, to host
+            region = self._seg_store()(
+                self.pool.alloc_region(1), sa[0],
+                jnp.int32(0), jnp.int32(0),
+            )
+            leaves = [
+                np.asarray(leaf)  # lint: sync-ok probe round-trips through host bytes by design
+                for leaf in jax.tree.leaves(region)
+            ]
+            lg = np.asarray(  # lint: sync-ok probe round-trips through host bytes by design
+                self._logit_row()(sa[1], jnp.int32(0))
+            )
+            # the actual wire: frame the bytes and re-decode them
+            if self._paged:
+                wire_leaves = slab_to_blocks(leaves, self._block_size)
+                layout, bs = "paged", self._block_size
+            else:
+                wire_leaves, layout, bs = leaves, "slab", 0
+            frame = encode_segment(
+                config_hash=self.config_hash, tokens=seq,
+                leaves=wire_leaves, logits=lg,
+                layout=layout, block_size=bs,
+            )
+            try:
+                dec = decode_segment(frame, expect_hash=self.config_hash)
+                slab = self._wire_slab(dec)
+            except WireError:
+                return False
+            if self._paged:
+                # land and re-fetch through a scratch block store, as
+                # ingest will (rows past n scatter to the sentinel)
+                bps = self.pool.tpad // self._block_size
+                blocks = jax.tree.map(
+                    lambda sh: jnp.zeros(
+                        (sh.shape[0], sh.shape[1], bps + 1,
+                         self._block_size, sh.shape[4]),
+                        sh.dtype,
+                    ),
+                    jax.eval_shape(
+                        lambda: self._init_caches(1, self.max_total)
+                    ),
+                )
+                row = jnp.asarray(np.arange(1, bps + 1, dtype=np.int32))
+                blocks = self._paged_seg_import()(blocks, row, slab)
+                slab = self._paged_seg_fetch()(blocks, row)
+            region2 = self._seg_import()(
+                self.pool.alloc_region(1), slab, jnp.int32(0)
+            )
+            # decode-side seat: the ordinary zero-prefill full hit
+            sc = self._hit_insert()(
+                *self._scratch_state(), region2,
+                jnp.asarray(dec["logits"]), jnp.int32(0), jnp.int32(0),
+                jnp.int32(n), jnp.int32(1), jnp.int32(_NO_EOS),
+            )
+            rows_c = self._slot_rows(sc[0], 0, n)
+            lg_c = np.asarray(sc[1][0])
+            return bool(
+                np.array_equal(lg_a, lg_c)
+                and all(np.array_equal(a, c)
+                        for a, c in zip(rows_a, rows_c))
+            )
+        finally:
+            self.prefill_dispatches = _disp
+            self._attr_suspend -= 1
+
+    def _disagg_ok(self) -> bool:
+        if self._disagg_ok_memo is None:
+            self._disagg_ok_memo = self._probe_verdict(
+                "disagg_wire", self._probe_disagg_parity,
+                n_slots=self.n_slots, max_total=self.max_total,
+                min_bucket=self._min_bucket, tpad=self.pool.tpad,
+                paged=self._paged, block_size=self._block_size,
+                tp=self.tp,
+            )
+            log_event(_log, "disagg_parity_probe",
+                      ok=self._disagg_ok_memo)
+            self.tracer.instant(ENGINE_TRACK, "disagg_parity_probe",
+                                ok=self._disagg_ok_memo)
+        return self._disagg_ok_memo
+
     def _batch_admission_ok(self) -> bool:
         if self._paged:
             # the batched admission programs are slab-landing (whole
@@ -2690,7 +3136,8 @@ class ServingEngine:
         state."""
         if not len(self.scheduler):
             return
-        if not (self.pool.n_free or self.scheduler.has_kind("embedding")):
+        if not (self.pool.n_free or self.scheduler.has_kind("embedding")
+                or self.scheduler.has_kind("kv_ingest")):
             return
         self._admitting += 1
         plans: list[_AdmitPlan] = []
@@ -2711,8 +3158,10 @@ class ServingEngine:
         reserved = [0]
 
         def admissible(r):
-            if r.kind != "generate":
-                return True  # embeddings are served host-side, slotless
+            if r.kind in ("embedding", "kv_ingest"):
+                return True  # served host-side at admission, slotless
+            # generate AND kv_export take the slot checks below
+            # (an export transiently holds a pool slot for its prefill)
             if self.pool.n_free == 0:
                 return False
             if self._paged:
@@ -2748,6 +3197,12 @@ class ServingEngine:
                     continue
                 if req.kind == "embedding":
                     self._serve_embedding(req, now)
+                    continue
+                if req.kind == "kv_ingest":
+                    self._serve_kv_ingest(req, now)  # lint: sync-ok wire seat must land before decode admits
+                    continue
+                if req.kind == "kv_export":
+                    self._serve_kv_export(req, now)  # lint: sync-ok export materializes the wire frame bytes
                     continue
                 plans.append(_AdmitPlan(req, self.pool.acquire()))
                 used[req.tenant_id] = used.get(req.tenant_id, 0) + 1

@@ -193,6 +193,23 @@ class ServingMetrics:
         # embedding requests served host-side (no KV slot)
         self.n_embeddings = 0
         self.embed_latency = Reservoir(reservoir_cap)
+        # disaggregated prefill/decode counters (see serving.disagg):
+        # exports = segments prefilled here for another replica,
+        # ingests = wire segments offered to the local prefix cache
+        # (stored or declined), transfers = push attempts to a decode
+        # replica's /v1/kv_segment, recorded by the HTTP layer
+        self.n_kv_exports = 0
+        self.kv_export_bytes = 0
+        self.n_kv_ingests_stored = 0
+        self.n_kv_ingests_declined = 0
+        self.kv_ingest_bytes = 0
+        self.n_transfers = 0
+        self.n_transfer_failures = 0
+        self.transfer_bytes = 0
+        self.transfer_seconds = 0.0
+        self.kv_export_latency = Reservoir(reservoir_cap)
+        self.kv_ingest_latency = Reservoir(reservoir_cap)
+        self.transfer_latency = Reservoir(reservoir_cap)
         self._reservoir_cap = reservoir_cap
         # per-tenant state, created lazily on the first event carrying a
         # non-empty tenant id. HTTP handler threads record rejections
@@ -289,6 +306,45 @@ class ServingMetrics:
         self._h_embed = reg.histogram(
             "serve_embedding_seconds",
             "Embedding request service time (host-side lookup).",
+        )
+        self._c_kv_exports = reg.counter(
+            "serve_kv_exports_total",
+            "KV segments prefilled here and exported for a decode "
+            "replica (disaggregated serving).",
+        )
+        self._c_kv_export_bytes = reg.counter(
+            "serve_kv_export_bytes_total",
+            "Raw segment bytes exported over the KV wire.",
+        )
+        self._h_kv_export = reg.histogram(
+            "serve_kv_export_seconds",
+            "Export service time: prefill + host snapshot.",
+        )
+        self._c_kv_ingests = reg.counter(
+            "serve_kv_ingests_total",
+            "Wire KV segments offered to the local prefix cache, by "
+            "result (stored|declined).", ("result",),
+        )
+        self._c_kv_ingest_bytes = reg.counter(
+            "serve_kv_ingest_bytes_total",
+            "Raw segment bytes received over the KV wire.",
+        )
+        self._h_kv_ingest = reg.histogram(
+            "serve_kv_ingest_seconds",
+            "Ingest service time: validate + device seat.",
+        )
+        self._c_transfers = reg.counter(
+            "serve_transfers_total",
+            "KV segment pushes to a decode replica, by result "
+            "(ok|failed).", ("result",),
+        )
+        self._c_transfer_bytes = reg.counter(
+            "serve_transfer_bytes_total",
+            "Frame bytes pushed to decode replicas over the KV wire.",
+        )
+        self._h_transfer = reg.histogram(
+            "serve_transfer_seconds",
+            "One KV segment push: POST /v1/kv_segment round trip.",
         )
         self._c_prog_seconds = reg.counter(
             "serve_program_seconds_total",
@@ -469,6 +525,60 @@ class ServingMetrics:
             with self._tlock:
                 self._tenant(tenant)["n_finished"] += 1
 
+    def record_kv_export(self, n_tokens: int, nbytes: int,
+                         seconds: float, tenant: str = "") -> None:
+        """One KV segment prefilled here for a decode replica
+        (``n_tokens`` of prompt, ``nbytes`` of raw segment bytes)."""
+        self.n_kv_exports += 1
+        self.kv_export_bytes += int(nbytes)
+        self.kv_export_latency.add(float(seconds))
+        self._c_kv_exports.inc()
+        self._c_kv_export_bytes.inc(int(nbytes))
+        self._h_kv_export.observe(seconds)
+        self._emit("kv_export_seconds", seconds)
+        if tenant:
+            self._c_tenant_requests.inc(tenant=tenant, outcome="kv_export")
+            with self._tlock:
+                self._tenant(tenant)["n_finished"] += 1
+
+    def record_kv_ingest(self, n_tokens: int, nbytes: int,
+                         seconds: float, *, stored: bool,
+                         tenant: str = "") -> None:
+        """One wire segment offered to the local prefix cache.
+        ``stored`` means the follow-up generate will full-hit; a
+        decline is soft (the sender falls back to local prefill)."""
+        if stored:
+            self.n_kv_ingests_stored += 1
+        else:
+            self.n_kv_ingests_declined += 1
+        self.kv_ingest_bytes += int(nbytes)
+        self.kv_ingest_latency.add(float(seconds))
+        self._c_kv_ingests.inc(result="stored" if stored else "declined")
+        self._c_kv_ingest_bytes.inc(int(nbytes))
+        self._h_kv_ingest.observe(seconds)
+        self._emit("kv_ingest_seconds", seconds)
+        if tenant:
+            self._c_tenant_requests.inc(tenant=tenant, outcome="kv_ingest")
+            with self._tlock:
+                self._tenant(tenant)["n_finished"] += 1
+
+    def record_transfer(self, nbytes: int, seconds: float, *,
+                        ok: bool = True) -> None:
+        """One KV segment push to a decode replica (HTTP layer).
+        Failed pushes record their wall time but no bytes — the
+        segment never landed."""
+        self.n_transfers += 1
+        self.transfer_latency.add(float(seconds))
+        self.transfer_seconds += float(seconds)
+        self._c_transfers.inc(result="ok" if ok else "failed")
+        self._h_transfer.observe(seconds)
+        self._emit("transfer_seconds", seconds)
+        if ok:
+            self.transfer_bytes += int(nbytes)
+            self._c_transfer_bytes.inc(int(nbytes))
+        else:
+            self.n_transfer_failures += 1
+
     def record_prefix_lookup(self, result: str, saved_tokens: int) -> None:
         """One admission-time prefix-cache lookup. ``result`` is
         ``hit_full``/``hit_partial``/``miss``; ``saved_tokens`` is how
@@ -617,6 +727,27 @@ class ServingMetrics:
         if self.n_embeddings:
             out["n_embeddings"] = self.n_embeddings
             out["embedding_p50_s"] = _pct(self.embed_latency, 50)
+        if (self.n_kv_exports or self.n_transfers
+                or self.n_kv_ingests_stored or self.n_kv_ingests_declined):
+            d = {
+                "kv_exports": self.n_kv_exports,
+                "kv_export_bytes": self.kv_export_bytes,
+                "kv_ingests_stored": self.n_kv_ingests_stored,
+                "kv_ingests_declined": self.n_kv_ingests_declined,
+                "kv_ingest_bytes": self.kv_ingest_bytes,
+                "transfers": self.n_transfers,
+                "transfer_failures": self.n_transfer_failures,
+                "transfer_bytes": self.transfer_bytes,
+            }
+            if self.kv_export_latency:
+                d["kv_export_p50_s"] = _pct(self.kv_export_latency, 50)
+            if self.transfer_latency:
+                d["transfer_p50_s"] = _pct(self.transfer_latency, 50)
+                if self.transfer_seconds > 0:
+                    d["transfer_bytes_per_s"] = (
+                        self.transfer_bytes / self.transfer_seconds
+                    )
+            out["disagg"] = d
         with self._tlock:
             if self.n_rejections:
                 out["rejections"] = dict(self.n_rejections)

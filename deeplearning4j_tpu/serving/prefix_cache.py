@@ -111,7 +111,12 @@ class PrefixCache:
 
     def __init__(self, pool: KVSlotPool, capacity_tokens: int,
                  on_evict: Callable[[Segment], None] | None = None,
-                 min_seg_len: int = 1, hit_weight: float = 4.0):
+                 min_seg_len: int = 1, hit_weight: float = 4.0,
+                 config_hash: str | None = None):
+        # every segment in this cache was computed under (or validated
+        # against) this model-config identity; wire-delivered segments
+        # carrying a different hash are rejected before insertion
+        self.config_hash = config_hash
         self.tpad = pool.tpad
         self.paged = bool(getattr(pool, "is_paged", False))
         if self.paged:

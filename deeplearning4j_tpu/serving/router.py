@@ -143,7 +143,8 @@ class _Replica:
 
     __slots__ = ("host", "port", "healthy", "in_flight", "routed",
                  "affinity_routed", "retried_away", "shadow",
-                 "last_health", "lock")
+                 "last_health", "lock", "draining", "incompatible",
+                 "config_hash")
 
     def __init__(self, host: str, port: int):
         self.host = host
@@ -161,6 +162,14 @@ class _Replica:
         self.shadow = PrefixShadow()
         self.last_health: dict | None = None
         self.lock = threading.Lock()
+        # replica reports draining (POST /drain): stop dispatching to
+        # it, resume when its health payload clears the flag
+        self.draining = False  # guarded-by: _route_lock
+        # first-seen model identity; a replica that comes back from a
+        # restart with a DIFFERENT hash is permanently excluded — it
+        # serves a different checkpoint now, not this fleet's model
+        self.config_hash: str | None = None
+        self.incompatible = False  # guarded-by: _route_lock
 
     @property
     def name(self) -> str:
@@ -169,6 +178,9 @@ class _Replica:
     def state(self) -> dict:  # lint: holds _route_lock
         return {
             "healthy": self.healthy,
+            "draining": self.draining,
+            "incompatible": self.incompatible,
+            "config_hash": self.config_hash,
             "in_flight": self.in_flight,
             "routed": self.routed,
             "affinity_routed": self.affinity_routed,
@@ -247,6 +259,15 @@ class ReplicaRouter:
             "Requests failed because no healthy replica remained.")
         self._m_healthy = reg.gauge(
             "router_replica_healthy", "1 while the replica is routable.",
+            labelnames=("replica",))
+        self._m_draining = reg.gauge(
+            "router_replica_draining",
+            "1 while the replica reports draining (POST /drain).",
+            labelnames=("replica",))
+        self._m_incompatible = reg.gauge(
+            "router_replica_incompatible",
+            "1 once the replica returned with a different model-config "
+            "hash (restarted onto the wrong checkpoint).",
             labelnames=("replica",))
         self._m_in_flight = reg.gauge(
             "router_replica_in_flight",
@@ -342,7 +363,8 @@ class ReplicaRouter:
         with self._route_lock:
             candidates = [
                 r for r in self.replicas
-                if r.healthy and r.name not in exclude
+                if r.healthy and not r.draining and not r.incompatible
+                and r.name not in exclude
             ]
             if not candidates:
                 raise _ReplicaDown("no healthy replica")
@@ -527,6 +549,44 @@ class ReplicaRouter:
             ok = False
         finally:
             conn.close()
+        hp = (replica.last_health
+              if isinstance(replica.last_health, dict) else None)
+        if ok and hp is not None:
+            # re-verify model identity on every successful poll: a
+            # replica that restarted onto a different checkpoint comes
+            # back ALIVE but must not silently rejoin the fleet — its
+            # answers (and its KV segments) belong to another model
+            cfg = hp.get("config_hash")
+            if cfg:
+                with self._route_lock:
+                    note_access(
+                        f"router.{replica.name}.config_hash", write=True)
+                    if replica.config_hash is None:
+                        replica.config_hash = str(cfg)
+                        newly_bad = False
+                    else:
+                        newly_bad = (replica.config_hash != str(cfg)
+                                     and not replica.incompatible)
+                        if newly_bad:
+                            replica.incompatible = True
+                if newly_bad:
+                    self._m_incompatible.set(1.0, replica=replica.name)
+                    log_event(_log, "router_replica_incompatible",
+                              replica=replica.name,
+                              expected=replica.config_hash[:12],
+                              got=str(cfg)[:12], level=logging.ERROR)
+            draining = bool(hp.get("draining"))
+            with self._route_lock:
+                note_access(f"router.{replica.name}.draining", write=True)
+                moved = draining != replica.draining
+                if moved:
+                    replica.draining = draining
+            if moved:
+                self._m_draining.set(float(draining), replica=replica.name)
+                log_event(_log,
+                          "router_replica_draining" if draining
+                          else "router_replica_resumed",
+                          replica=replica.name)
         if ok:
             with self._route_lock:
                 note_access(f"router.{replica.name}.healthy", write=True)

@@ -31,7 +31,11 @@ Endpoints:
 - ``POST /v1/generate`` — body ``{"prompt": [ints] | "text",
   "max_new": int, "priority"?: int, "eos_token"?: int,
   "deadline_s"?: float, "adapter"?: int, "stream"?: bool}``; returns
-  ``{"id", "tokens", "text"?}``. 429 on queue backpressure or tenant
+  ``{"id", "tokens", "text"?, "timing"?}`` where ``timing`` is
+  ``{"ttft_s", "decode_s"}`` — engine-local time to first token and
+  wall time after it (end-to-end TTFT = request wall - ``decode_s``,
+  which counts queueing and any disagg prefill/transfer leg). 429 on
+  queue backpressure or tenant
   quota, 400 on a request that can never fit a slot (or an adapter
   index outside the loaded LoRA bank), 401 on an unknown API key, 503
   while draining/stopped, 408 when ``deadline_s`` expired, 500 when
@@ -89,6 +93,7 @@ Text prompts/completions use the repo's byte-level convention
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
@@ -101,12 +106,24 @@ from pathlib import Path
 from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.obs.logs import log_event
-from deeplearning4j_tpu.obs.trace import new_trace_id, parse_traceparent
+from deeplearning4j_tpu.obs.trace import (
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+from deeplearning4j_tpu.serving.disagg import (
+    WireError,
+    decode_segment,
+    encode_segment,
+)
 from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.scheduler import (
     AdmissionError,
     Backpressure,
     EmbeddingRequest,
+    KVExportRequest,
+    KVIngestRequest,
     Request,
     RequestStatus,
 )
@@ -156,6 +173,11 @@ class ServingServer:
         self._hang_dumped = False
         self._stop = threading.Event()
         self._draining = threading.Event()
+        # admission pause via POST /drain — distinct from _draining
+        # (stop()'s terminal drain makes the engine loop EXIT once
+        # idle; a paused server keeps its loop and caches alive and
+        # resumes on /undrain — the rolling-restart primitive)
+        self._paused = threading.Event()
         self._engine_dead = threading.Event()
         self._last_error: str | None = None
         # watchdog heartbeat: stamped at the top of every engine-loop
@@ -171,7 +193,9 @@ class ServingServer:
         ).set_function(lambda: float(self._health_payload()["ok"]))
         reg.gauge(
             "serve_draining", "1 while the server is draining.",
-        ).set_function(lambda: float(self._draining.is_set()))
+        ).set_function(lambda: float(
+            self._draining.is_set() or self._paused.is_set()
+        ))
         server = self
 
         class Handler(QuietHandler):
@@ -184,10 +208,17 @@ class ServingServer:
                 if path == "/profile":
                     server._handle_profile(self)
                     return
-                if path not in ("/v1/generate", "/v1/embeddings"):
+                if path in ("/drain", "/undrain"):
+                    # reachable while paused by design: the controller
+                    # must be able to undrain a replica it drained
+                    server._handle_drain(self, path == "/drain")
+                    return
+                if path not in ("/v1/generate", "/v1/embeddings",
+                                "/v1/kv_segment", "/v1/prefill"):
                     send_json(self, 404, {"error": "not found"})
                     return
-                if server._draining.is_set() or server._stop.is_set():
+                if (server._draining.is_set() or server._paused.is_set()
+                        or server._stop.is_set()):
                     send_json(self, 503, {"error": "draining"})
                     return
                 if server._engine_dead.is_set():
@@ -200,12 +231,18 @@ class ServingServer:
                 if tenant is _UNKNOWN_KEY:
                     send_json(self, 401, {"error": "unknown API key"})
                     return
+                if path == "/v1/kv_segment":
+                    # binary wire frame, not JSON
+                    server._handle_kv_segment(self, tenant)
+                    return
                 body = read_json_body(self)
                 if body is None:
                     send_json(self, 400, {"error": "malformed JSON"})
                     return
                 if path == "/v1/embeddings":
                     server._handle_embeddings(self, body, tenant)
+                elif path == "/v1/prefill":
+                    server._handle_prefill(self, body, tenant)
                 else:
                     server._handle_generate(self, body, tenant)
 
@@ -472,6 +509,10 @@ class ServingServer:
                   trace_id=req.trace_id or None)
         self._access_log(handler, req, 200, "finished", n_tokens=n_new)
         out = {"id": req.id, "tokens": toks}
+        timing = getattr(req, "timing", None)
+        if timing is not None:
+            out["timing"] = {k: round(float(v), 6)
+                             for k, v in timing.items()}
         if self._byte_vocab():
             out["text"] = bytes(
                 t % 256 for t in toks
@@ -618,6 +659,227 @@ class ServingServer:
             "id": req.id, "model": req.model, "vectors": vectors,
         })
 
+    # -- disaggregated prefill/decode ---------------------------------
+
+    def _handle_drain(self, handler, draining: bool) -> None:
+        """``POST /drain`` / ``POST /undrain``: pause or resume
+        admission without stopping the engine loop. ``/readyz`` flips
+        to 503 so routers stop dispatching; in-flight and queued work
+        still finishes (the loop keeps stepping — only NEW submits get
+        503); ``/undrain`` restores readiness. Idempotent both ways."""
+        if draining:
+            self._paused.set()
+        else:
+            self._paused.clear()
+        log_event(_log, "drain" if draining else "undrain",
+                  in_flight=self.engine.pool.n_active,
+                  queued=len(self.engine.scheduler))
+        send_json(handler, 200, {
+            "draining": self._paused.is_set(),
+            "in_flight": self.engine.pool.n_active,
+            "queued": len(self.engine.scheduler),
+        })
+
+    def _handle_kv_segment(self, handler, tenant) -> None:
+        """``POST /v1/kv_segment``: ingest one binary KV-segment frame
+        (see :mod:`..serving.disagg`) and seat it in the prefix cache
+        through the engine's admission loop. 400/409 come straight from
+        ``WireError.status``; otherwise 200 with ``{"stored": bool,
+        "reason"}`` — a decline (cache full, parity probe failed) is
+        not an error, the sender just forfeits the transfer win."""
+        try:
+            length = int(handler.headers.get("Content-Length", "0"))
+            data = handler.rfile.read(length)
+        except (ValueError, OSError):
+            send_json(handler, 400, {"error": "unreadable body"})
+            return
+        try:
+            seg = decode_segment(data, expect_hash=self.engine.config_hash)
+        except WireError as e:
+            log_event(_log, "kv_segment_rejected", error=str(e),
+                      http=e.status, nbytes=len(data))
+            send_json(handler, e.status, {"error": str(e)})
+            return
+        req = KVIngestRequest(
+            segment=seg,
+            priority=tenant.priority if tenant is not None else 1,
+            tenant_id=tenant.tenant_id if tenant is not None else "",
+            done=threading.Event(),
+        )
+        self._resolve_trace(handler, req)
+        try:
+            self.engine.submit(req)
+        except Backpressure as e:
+            self._access_log(handler, req, 429, "backpressure",
+                             kind="kv_ingest")
+            send_json(handler, 429, {"error": str(e)})
+            return
+        except AdmissionError as e:
+            self._access_log(handler, req, 400, "admission_error",
+                             kind="kv_ingest")
+            send_json(handler, 400, {"error": str(e)})
+            return
+        if not req.done.wait(self.request_timeout_s):
+            req.cancel()
+            self._access_log(handler, req, 504, "timeout",
+                             kind="kv_ingest")
+            send_json(handler, 504, {"error": "kv ingest timed out"})
+            return
+        if req.status is not RequestStatus.FINISHED:
+            code = _STATUS_HTTP.get(req.status, 500)
+            self._access_log(handler, req, code, req.status.value,
+                             kind="kv_ingest")
+            send_json(handler, code, {
+                "id": req.id,
+                "status": req.status.value,
+                "error": req.error or req.status.value,
+            })
+            return
+        self._access_log(handler, req, 200, "finished", kind="kv_ingest",
+                         stored=bool(req.result.get("stored")))
+        send_json(handler, 200, {"id": req.id, **req.result})
+
+    def _handle_prefill(self, handler, body: dict, tenant) -> None:
+        """``POST /v1/prefill``: prefill-only — compute the prompt's KV
+        rows, frame them for the wire, and (with ``"push_to":
+        "host:port"``) push the frame to a decode replica's
+        ``/v1/kv_segment``. Returns frame metadata, never the frame
+        itself; a failed push answers 200 with ``"pushed": false`` so
+        the caller (the fleet controller) falls back to local prefill
+        on the decode side — same bytes, just slower."""
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            if not self._byte_vocab():
+                send_json(handler, 400, {
+                    "error": "text prompts need a byte-level model "
+                             "(vocab <= 256)",
+                })
+                return
+            prompt = list(prompt.encode("latin-1", errors="replace"))
+        if not isinstance(prompt, list) or not prompt:
+            send_json(handler, 400, {
+                "error": "'prompt' must be a non-empty token list "
+                         "or a string",
+            })
+            return
+        req = KVExportRequest(
+            prompt=prompt,
+            priority=int(body.get(
+                "priority", tenant.priority if tenant is not None else 1
+            )),
+            adapter=int(body.get(
+                "adapter",
+                tenant.default_adapter if tenant is not None else 0,
+            )),
+            tenant_id=tenant.tenant_id if tenant is not None else "",
+            done=threading.Event(),
+        )
+        self._resolve_trace(handler, req)
+        try:
+            self.engine.submit(req)
+        except Backpressure as e:
+            self._access_log(handler, req, 429, "backpressure",
+                             kind="kv_export")
+            send_json(handler, 429, {"error": str(e)})
+            return
+        except AdmissionError as e:
+            self._access_log(handler, req, 400, "admission_error",
+                             kind="kv_export")
+            send_json(handler, 400, {"error": str(e)})
+            return
+        if not req.done.wait(self.request_timeout_s):
+            req.cancel()
+            self._access_log(handler, req, 504, "timeout",
+                             kind="kv_export")
+            send_json(handler, 504, {"error": "prefill timed out"})
+            return
+        if req.status is not RequestStatus.FINISHED:
+            code = _STATUS_HTTP.get(req.status, 500)
+            self._access_log(handler, req, code, req.status.value,
+                             kind="kv_export")
+            send_json(handler, code, {
+                "id": req.id,
+                "status": req.status.value,
+                "error": req.error or req.status.value,
+            })
+            return
+        res = req.result
+        frame = encode_segment(
+            config_hash=res["config_hash"], tokens=res["tokens"],
+            leaves=res["leaves"], logits=res["logits"],
+            layout=res["layout"], block_size=res["block_size"],
+        )
+        out = {"id": req.id, "n_tokens": len(req.prompt),
+               "nbytes": len(frame), "config_hash": res["config_hash"]}
+        push_to = body.get("push_to")
+        if push_to:
+            pushed, info = self._push_segment(
+                str(push_to), frame, req, res.get("span_id")
+            )
+            out["pushed"] = pushed
+            if info:
+                out["ingest"] = info
+        self._access_log(handler, req, 200, "finished", kind="kv_export",
+                         n_tokens=len(req.prompt), nbytes=len(frame))
+        send_json(handler, 200, out)
+
+    def _push_segment(self, target: str, frame: bytes, req,
+                      parent_span: str | None) -> tuple[bool, dict]:
+        """POST the frame to ``target``'s ``/v1/kv_segment``; returns
+        ``(ok, ingest response)``. Emits a real "transfer" span — the
+        flow anchor chaining prefill -> transfer -> decode ingest in
+        the merged fleet trace (the outgoing ``traceparent`` names this
+        span as the ingest's parent) — and records transfer
+        bytes/latency either way: failed pushes are a first-class
+        fleet signal, not silence."""
+        host, _, port = target.rpartition(":")
+        t0 = time.perf_counter()
+        span_id = new_span_id()
+        info: dict = {}
+        ok = False
+        err = None
+        try:
+            conn = http.client.HTTPConnection(
+                host or "127.0.0.1", int(port), timeout=30
+            )
+            headers = {"Content-Type": "application/octet-stream"}
+            if req.trace_id:
+                headers["traceparent"] = format_traceparent(
+                    req.trace_id, span_id
+                )
+            conn.request("POST", "/v1/kv_segment", body=frame,
+                         headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            conn.close()
+            try:
+                info = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                info = {}
+            ok = resp.status == 200 and bool(info.get("stored"))
+            if resp.status != 200:
+                err = "http %d: %s" % (resp.status, info.get("error"))
+        except (OSError, ValueError) as e:
+            err = repr(e)
+        dt = time.perf_counter() - t0
+        self.engine.metrics.record_transfer(len(frame), dt, ok=ok)
+        tctx = {}
+        if self.engine.tracer.enabled and req.trace_id:
+            tctx = {"trace_id": req.trace_id, "span_id": span_id}
+            if parent_span:
+                tctx["parent_span_id"] = parent_span
+        self.engine.tracer.span(
+            "transfer", "transfer", t0, dt, target=target,
+            nbytes=len(frame), ok=ok, **tctx,
+        )
+        log_event(_log, "kv_transfer", target=target, nbytes=len(frame),
+                  ok=ok, seconds=round(dt, 6), error=err,
+                  stored=bool(info.get("stored")))
+        if err:
+            info = dict(info)
+            info["error"] = err
+        return ok, info
+
     def _hung(self, now: float | None = None) -> tuple[bool, float | None]:
         """(hung?, beat_age_s). Hung = the loop thread is alive but its
         heartbeat is older than ``hang_threshold_s`` while the engine
@@ -655,9 +917,14 @@ class ServingServer:
             "hung": hung,
             "beat_age_s": beat_age,
             "hang_threshold_s": self.hang_threshold_s,
-            "draining": self._draining.is_set(),
+            "draining": self._draining.is_set() or self._paused.is_set(),
             "last_error": self._last_error,
             "restarts": self.engine.metrics.n_restarts,
+            # fleet fields: the controller routes on these (a restarted
+            # replica with a different checkpoint shows a new hash)
+            "config_hash": self.engine.config_hash,
+            "queue_depth": len(self.engine.scheduler),
+            "idle": self.engine.idle,
         }
 
     def _metrics_payload(self) -> dict:
@@ -667,7 +934,7 @@ class ServingServer:
             n_slots=eng.n_slots,
             slots_active=eng.pool.n_active,
             queue_depth=len(eng.scheduler),
-            draining=self._draining.is_set(),
+            draining=self._draining.is_set() or self._paused.is_set(),
             engine_alive=self._engine_thread.is_alive()
             and not self._engine_dead.is_set(),
             last_error=self._last_error,

@@ -1,15 +1,22 @@
 #!/usr/bin/env python
-"""Fleet observability smoke: router + 2 demo replicas over real HTTP.
+"""Fleet observability smoke: router + controller + 2 demo replicas
+over real HTTP.
 
 Boots two ``serve --demo`` replica processes and one ``router`` process
 (each exporting its tracer via --trace-out), drives generate requests
 through the router, checks the live observability surfaces
 (``/debug/dump`` flight bundle, per-family ``serve_program_seconds``
-attribution on ``/metrics``), shuts the fleet down, stitches the three
-per-process trace exports with ``trace-merge``, and validates the
-merged document structurally: >= 3 process tracks, every replica
-admission span's ``parent_span_id`` resolving to a router dispatch
-span on a different track, and cross-process flow arrows present.
+attribution on ``/metrics``). Then boots a ``controller`` over the SAME
+replicas with disaggregated roles (replica 0 = prefill, replica 1 =
+decode) and sends one long-prompt request through the transfer path —
+prefill computes the KV segment and pushes it replica-to-replica to the
+decode target, whose generate full-hits. Shuts the fleet down, stitches
+the per-process trace exports with ``trace-merge``, and validates the
+merged document structurally: >= 4 process tracks, every replica
+admission span's ``parent_span_id`` resolving to a dispatch span on a
+different track, cross-process flow arrows present, and the disagg
+chain controller dispatch -> export prefill -> transfer -> kv_ingest
+joined under ONE trace id.
 
 CI runs this as the fleet lane; it is also a one-command local repro:
 
@@ -56,6 +63,13 @@ def post_generate(addr, body, timeout=120):
         return r.status, json.loads(r.read())
 
 
+def prom_value(text, series):
+    for line in text.splitlines():
+        if line.startswith(series + " "):
+            return float(line.split()[-1])
+    raise SystemExit(f"{series} missing from /metrics")
+
+
 def main():
     tmp = tempfile.mkdtemp(prefix="fleet-smoke-")
     env = dict(os.environ)
@@ -76,6 +90,9 @@ def main():
                 "--n-layers", "2", "--n-heads", "4",
                 "--port-file", pf, "--trace-out", trace,
                 "--flight-dir", tmp,
+                # replica 1 is the controller phase's decode target:
+                # wire segments seat in its prefix cache
+                *(["--prefix-cache"] if i == 1 else []),
             ], env=env))
         addrs = [wait_port_file(pf, procs) for pf in port_files]
         print(f"replicas up: {addrs}")
@@ -112,6 +129,37 @@ def main():
             "no per-family attribution on /metrics"
         assert "serve_mfu{" in metrics, "no serve_mfu gauges"
         print("debug dumps + attribution metrics OK")
+
+        # -- disaggregated phase: controller over the same replicas --
+        cpf = os.path.join(tmp, "controller.port")
+        ctrace = os.path.join(tmp, "controller.trace.json")
+        traces.insert(0, ctrace)
+        procs.append(subprocess.Popen([
+            sys.executable, "-m", "deeplearning4j_tpu", "controller",
+            "--replica", f"{addrs[0]['host']}:{addrs[0]['port']}=prefill",
+            "--replica", f"{addrs[1]['host']}:{addrs[1]['port']}=decode",
+            "--disagg-threshold", "12", "--port", "0",
+            "--port-file", cpf, "--trace-out", ctrace,
+            "--flight-dir", tmp,
+        ], env=env))
+        caddr = wait_port_file(cpf, procs)
+        print(f"controller up: {caddr}")
+
+        # 16 tokens >= threshold: prefill computes KV on replica 0,
+        # pushes the segment to replica 1, the generate full-hits there
+        status, body = post_generate(
+            caddr, {"prompt": list(range(1, 17)), "max_new": 3})
+        assert status == 200 and body.get("tokens"), body
+        pmx = get(addrs[0], "/metrics").decode()
+        assert prom_value(
+            pmx, 'serve_transfers_total{result="ok"}') >= 1, \
+            "prefill replica recorded no successful transfer"
+        assert prom_value(pmx, "serve_transfer_bytes_total") > 0
+        dmx = get(addrs[1], "/metrics").decode()
+        assert prom_value(
+            dmx, 'serve_kv_ingests_total{result="stored"}') >= 1, \
+            "decode replica seated no wire segment"
+        print("disagg transfer path OK (segment pushed + seated)")
     finally:
         # SIGINT = the CLI's clean path: drain, then export --trace-out
         for p in reversed(procs):
@@ -134,7 +182,7 @@ def main():
         doc = json.load(f)
     evs = doc["traceEvents"]
     pids = {e["pid"] for e in evs}
-    assert len(pids) >= 3, f"expected >= 3 process tracks, got {pids}"
+    assert len(pids) >= 4, f"expected >= 4 process tracks, got {pids}"
     dispatches = {
         e["args"]["span_id"]: e for e in evs
         if e.get("ph") == "X" and e["name"] == "dispatch"
@@ -154,9 +202,34 @@ def main():
         assert parent["args"]["trace_id"] == adm["args"]["trace_id"]
     n_flows = sum(1 for e in evs if e.get("ph") == "s")
     assert n_flows >= n_requests, f"only {n_flows} flow arrows"
+
+    # the disagg chain: controller dispatch -> export prefill ->
+    # transfer -> kv_ingest, one trace id end to end, each hop on a
+    # different process track
+    by_span = {e["args"]["span_id"]: e for e in evs
+               if e.get("ph") == "X" and "span_id" in e.get("args", {})}
+    transfers = [e for e in evs
+                 if e.get("ph") == "X" and e["name"] == "transfer"]
+    assert transfers, "no transfer span in the merged trace"
+    tr = transfers[0]
+    exp = by_span[tr["args"]["parent_span_id"]]
+    assert exp["name"] == "prefill" and \
+        exp["args"].get("prefix") == "export", exp
+    ing = next(e for e in evs
+               if e.get("ph") == "X" and e["name"] == "kv_ingest")
+    assert ing["args"]["parent_span_id"] == tr["args"]["span_id"]
+    tid = tr["args"]["trace_id"]
+    assert exp["args"]["trace_id"] == ing["args"]["trace_id"] == tid
+    root = by_span[exp["args"]["parent_span_id"]]
+    assert root["name"] == "dispatch" and \
+        root["args"].get("leg") == "prefill", root
+    assert len({root["pid"], exp["pid"], ing["pid"]}) == 3, \
+        "disagg chain does not cross three processes"
     print(f"merged trace OK: {len(pids)} tracks, "
-          f"{len(admissions)} admission spans all parented to router "
-          f"dispatches, {n_flows} flow arrows -> {merged_path}")
+          f"{len(admissions)} admission spans all parented to "
+          f"dispatches, {n_flows} flow arrows, disagg chain "
+          f"controller->prefill->transfer->ingest under trace {tid} "
+          f"-> {merged_path}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,667 @@
+"""Disaggregated prefill/decode serving: the KV-segment wire format,
+engine-level seat-path parity, the fleet controller, and
+rolling-restart drain.
+
+The tentpole contract pinned here: a generate request whose prompt
+prefills on one replica and decodes on another — the KV segment
+travelling as a versioned binary frame over HTTP — produces a token
+stream BYTE-IDENTICAL to a monolithic replica's, greedy and sampled
+alike, including across a decode-replica crash. Everything about the
+transfer path is soft: any rejection (truncated frame, config-hash
+mismatch, cache decline) falls back to local prefill, which is the
+same bytes anyway.
+"""
+
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from deeplearning4j_tpu.obs import Tracer, merge_traces
+from deeplearning4j_tpu.serving import (
+    FaultInjector,
+    FleetController,
+    KVExportRequest,
+    KVIngestRequest,
+    Request,
+    RequestStatus,
+    RoleBalancer,
+    ServingEngine,
+    ServingServer,
+    WireError,
+    decode_segment,
+    encode_segment,
+)
+from deeplearning4j_tpu.serving.disagg import (
+    WIRE_MAGIC,
+    blocks_to_slab,
+    slab_to_blocks,
+)
+from deeplearning4j_tpu.serving.router import ReplicaRouter
+from deeplearning4j_tpu.utils.httpjson import QuietHandler, send_json
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=32
+)
+_PARAMS = {}
+
+
+def _params(seed=0):
+    if seed not in _PARAMS:
+        _PARAMS[seed] = init_transformer(jax.random.key(seed), CFG)
+    return _PARAMS[seed]
+
+
+def _drain_one(engine, req, max_steps=500):
+    engine.submit(req)
+    for _ in range(max_steps):
+        engine.step()
+        if req.done.is_set():
+            return req
+    raise AssertionError(f"request {req.id} never finished")
+
+
+def _export_frame(engine, prompt):
+    """Run a KVExportRequest through ``engine`` and frame the result."""
+    req = _drain_one(engine, KVExportRequest(
+        prompt=np.asarray(prompt, np.int32), done=threading.Event()))
+    assert req.status == RequestStatus.FINISHED, req.error
+    res = req.result
+    return encode_segment(
+        config_hash=res["config_hash"], tokens=res["tokens"],
+        leaves=res["leaves"], logits=res["logits"],
+        layout=res["layout"], block_size=res["block_size"],
+    )
+
+
+def _ingest(engine, frame):
+    seg = decode_segment(frame, expect_hash=engine.config_hash)
+    req = _drain_one(engine, KVIngestRequest(
+        segment=seg, done=threading.Event()))
+    assert req.status == RequestStatus.FINISHED
+    return req.result
+
+
+# -- wire format ----------------------------------------------------------
+
+
+def _slab_leaves(dtype, seed=0):
+    """Two (L, C, 1, Tpad, H) leaves in the given dtype."""
+    rng = np.random.default_rng(seed)
+    raw = [rng.standard_normal((2, 2, 1, 16, 8)) for _ in range(2)]
+    return [a.astype(dtype) for a in raw]
+
+
+def _roundtrip(leaves, logits, **kw):
+    frame = encode_segment(
+        config_hash="h" * 64, tokens=[3, 5, 7], leaves=leaves,
+        logits=logits, **kw)
+    return frame, decode_segment(frame)
+
+
+def test_wire_roundtrip_bf16_exact():
+    import ml_dtypes
+
+    leaves = _slab_leaves(ml_dtypes.bfloat16)
+    logits = np.random.default_rng(1).standard_normal(
+        (1, CFG.vocab_size)).astype(np.float32)
+    frame, dec = _roundtrip(leaves, logits)
+    assert dec["config_hash"] == "h" * 64
+    assert dec["layout"] == "slab" and dec["block_size"] == 0
+    np.testing.assert_array_equal(dec["tokens"],
+                                  np.asarray([3, 5, 7], np.int32))
+    assert dec["tokens"].dtype == np.int32
+    assert dec["nbytes"] == len(frame)
+    for a, b in zip(leaves, dec["leaves"]):
+        assert b.dtype == a.dtype and b.shape == a.shape
+        assert b.tobytes() == a.tobytes()  # bitwise, not approx
+    assert dec["logits"].tobytes() == logits.tobytes()
+
+
+def test_wire_roundtrip_int8_with_scale_planes():
+    """int8 segments ship their f32 scale planes as ordinary extra
+    leaves — mixed dtypes in one frame round-trip bitwise."""
+    rng = np.random.default_rng(2)
+    q = rng.integers(-128, 128, (2, 2, 1, 16, 8)).astype(np.int8)
+    scales = rng.standard_normal((2, 2, 1, 16, 1)).astype(np.float32)
+    logits = rng.standard_normal((1, CFG.vocab_size)).astype(np.float32)
+    _, dec = _roundtrip([q, scales], logits)
+    assert dec["leaves"][0].dtype == np.int8
+    assert dec["leaves"][1].dtype == np.float32
+    assert dec["leaves"][0].tobytes() == q.tobytes()
+    assert dec["leaves"][1].tobytes() == scales.tobytes()
+
+
+def test_wire_paged_blocklist_layout_roundtrip():
+    """Paged frames carry block-list leaves; the receiver reassembles
+    the batch-1 slab. slab->blocks->slab is the identity."""
+    leaves = _slab_leaves(np.float32, seed=3)
+    blocks = slab_to_blocks(leaves, block_size=4)
+    assert blocks[0].shape == (2, 2, 4, 4, 8)
+    back = blocks_to_slab(blocks)
+    for a, b in zip(leaves, back):
+        assert b.shape == a.shape and b.tobytes() == a.tobytes()
+
+    logits = np.zeros((1, CFG.vocab_size), np.float32)
+    _, dec = _roundtrip(blocks, logits, layout="paged", block_size=4)
+    assert dec["layout"] == "paged" and dec["block_size"] == 4
+    for a, b in zip(leaves, dec["leaves"]):  # slab form comes back
+        assert b.shape == a.shape and b.tobytes() == a.tobytes()
+
+    with pytest.raises(WireError):  # 16 rows don't split into 5-blocks
+        slab_to_blocks(leaves, block_size=5)
+    with pytest.raises(WireError):
+        encode_segment(config_hash="h", tokens=[1], leaves=blocks,
+                       logits=logits, layout="paged", block_size=0)
+
+
+def test_wire_rejects_truncated_and_trailing():
+    frame, _ = _roundtrip(_slab_leaves(np.float32),
+                          np.zeros((1, 64), np.float32))
+    for cut in (3, len(frame) // 2, len(frame) - 1):
+        with pytest.raises(WireError) as ei:
+            decode_segment(frame[:cut])
+        assert ei.value.status == 400
+    with pytest.raises(WireError, match="trailing"):
+        decode_segment(frame + b"\x00")
+
+
+def test_wire_rejects_bad_magic_version_and_header():
+    frame, _ = _roundtrip(_slab_leaves(np.float32),
+                          np.zeros((1, 64), np.float32))
+    assert frame[:4] == WIRE_MAGIC
+    with pytest.raises(WireError, match="magic"):
+        decode_segment(b"XXXX" + frame[4:])
+    with pytest.raises(WireError, match="version"):
+        decode_segment(frame[:4] + b"\xff\x00" + frame[6:])
+    # garbage where the JSON header should be
+    with pytest.raises(WireError):
+        decode_segment(frame[:10] + b"\xff" * (len(frame) - 10))
+
+
+def test_wire_config_hash_mismatch_is_409():
+    frame, _ = _roundtrip(_slab_leaves(np.float32),
+                          np.zeros((1, 64), np.float32))
+    with pytest.raises(WireError) as ei:
+        decode_segment(frame, expect_hash="x" * 64)
+    assert ei.value.status == 409
+    # no expectation -> parses fine (the engine re-checks at seat time)
+    assert decode_segment(frame)["config_hash"] == "h" * 64
+
+
+# -- role balancer (pure policy) ------------------------------------------
+
+
+def _samples(pf_q, dc_q, dc_burn=0.0):
+    return {
+        "p0": {"role": "prefill", "queue_depth": pf_q, "slo_burn": 0.0},
+        "p1": {"role": "prefill", "queue_depth": pf_q, "slo_burn": 0.0},
+        "d0": {"role": "decode", "queue_depth": dc_q,
+               "slo_burn": dc_burn},
+    }
+
+
+def test_balancer_needs_consecutive_windows_and_dwell():
+    b = RoleBalancer(threshold=2.0, windows=3, dwell_s=10.0)
+    # two imbalanced samples: streak not reached, no move
+    assert b.observe(0.0, _samples(0, 8)) == []
+    assert b.observe(1.0, _samples(0, 8)) == []
+    # a calm sample resets the streak entirely
+    assert b.observe(2.0, _samples(4, 4)) == []
+    assert b.observe(3.0, _samples(0, 8)) == []
+    assert b.observe(4.0, _samples(0, 8)) == []
+    moves = b.observe(5.0, _samples(0, 8))
+    assert moves == [("p0", "decode")] or moves == [("p1", "decode")]
+    # the imbalance persists but the dwell window holds moves back
+    for t in (6.0, 7.0, 8.0):
+        assert b.observe(t, _samples(0, 8)) == []
+    # ... and releases once dwell_s has elapsed since the last move
+    assert b.observe(16.0, _samples(0, 8)) != []
+
+
+def test_balancer_never_empties_a_role():
+    b = RoleBalancer(threshold=2.0, windows=1, dwell_s=0.0)
+    one_each = {
+        "p0": {"role": "prefill", "queue_depth": 9, "slo_burn": 0.0},
+        "d0": {"role": "decode", "queue_depth": 0, "slo_burn": 0.0},
+    }
+    # prefill overloaded, but the decode pool has a single member:
+    # donating it would empty the role
+    for t in range(5):
+        assert b.observe(float(t), one_each) == []
+
+
+def test_balancer_slo_burn_counts_as_decode_pressure():
+    b = RoleBalancer(threshold=2.0, windows=1, dwell_s=0.0,
+                     slo_weight=4.0)
+    # queues balanced, but decode tenants burn 3x their TPOT budget
+    moves = b.observe(0.0, _samples(1, 1, dc_burn=3.0))
+    assert moves and moves[0][1] == "decode"
+    # burn <= 1.0 (objective met) adds nothing
+    b2 = RoleBalancer(threshold=2.0, windows=1, dwell_s=0.0)
+    assert b2.observe(0.0, _samples(1, 1, dc_burn=0.9)) == []
+
+
+def test_balancer_ignores_monolithic_and_missing_pools():
+    b = RoleBalancer(windows=1, dwell_s=0.0)
+    mono = {
+        "m0": {"role": "monolithic", "queue_depth": 50, "slo_burn": 9.0},
+        "m1": {"role": "monolithic", "queue_depth": 0, "slo_burn": 0.0},
+    }
+    assert b.observe(0.0, mono) == []  # no pools at all
+    no_decode = {
+        "p0": {"role": "prefill", "queue_depth": 50, "slo_burn": 0.0},
+        "p1": {"role": "prefill", "queue_depth": 50, "slo_burn": 0.0},
+    }
+    assert b.observe(1.0, no_decode) == []
+
+
+# -- engine-level disagg parity -------------------------------------------
+
+
+def _gen(engine, prompt, max_new=5):
+    req = _drain_one(engine, Request(
+        prompt=np.asarray(prompt, np.int32), max_new=max_new,
+        done=threading.Event()))
+    assert req.status == RequestStatus.FINISHED, req.error
+    return engine.pop_result(req.id)
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8],
+                         ids=["greedy", "sampled"])
+def test_engine_disagg_parity(temperature):
+    """Prefill on engine A, ship the frame, seat on engine B, decode:
+    byte-identical to a monolithic engine that never saw the wire —
+    and the seated generate dispatches ZERO prefill programs (the
+    full-hit admission is a pure copy)."""
+    prompt = list(np.random.default_rng(7).integers(1, 60, 16))
+    kw = dict(n_slots=2, temperature=temperature, decode_horizon=2,
+              rng_seed=5)
+    pf_eng = ServingEngine(CFG, _params(), **kw)
+    dc_eng = ServingEngine(CFG, _params(), prefix_cache=True, **kw)
+    mono = ServingEngine(CFG, _params(), **kw)
+
+    frame = _export_frame(pf_eng, prompt)
+    res = _ingest(dc_eng, frame)
+    assert res["stored"], res["reason"]
+    assert dc_eng.prefill_dispatches == 0
+
+    out_disagg = _gen(dc_eng, prompt)
+    assert dc_eng.prefill_dispatches == 0  # full hit, pure copy
+    out_mono = _gen(mono, prompt)
+    np.testing.assert_array_equal(out_disagg, out_mono)
+
+
+def test_engine_ingest_declines_are_soft():
+    """Every decline reports stored=False + a reason and the engine
+    keeps serving; a hash-foreign segment is refused at seat time even
+    if the HTTP layer forgot to check."""
+    eng = ServingEngine(CFG, _params(), n_slots=2, temperature=0.0,
+                        decode_horizon=2, prefix_cache=True)
+    pf = ServingEngine(CFG, _params(), n_slots=2, temperature=0.0,
+                       decode_horizon=2)
+    prompt = list(np.random.default_rng(9).integers(1, 60, 16))
+    frame = _export_frame(pf, prompt)
+    seg = decode_segment(frame)
+    seg["config_hash"] = "not-this-model"
+    req = _drain_one(eng, KVIngestRequest(segment=seg,
+                                          done=threading.Event()))
+    assert req.result["stored"] is False
+    assert "hash" in req.result["reason"]
+
+    # an engine without a prefix cache declines too (no seat exists)
+    bare = ServingEngine(CFG, _params(), n_slots=2, temperature=0.0,
+                         decode_horizon=2)
+    res = _ingest(bare, frame)
+    assert res["stored"] is False and "prefix cache" in res["reason"]
+
+    # ... and generation still works fine after declines
+    out = _gen(eng, prompt)
+    np.testing.assert_array_equal(out, _gen(pf, prompt))
+
+
+@pytest.mark.chaos
+def test_engine_disagg_parity_across_decode_crash():
+    """The decode replica crashes mid-decode AFTER seating a wire
+    segment; supervised recovery replays and the stream still matches
+    the monolithic reference byte for byte."""
+    prompt = list(np.random.default_rng(11).integers(1, 60, 16))
+    kw = dict(n_slots=2, temperature=0.8, decode_horizon=2, rng_seed=3,
+              retry_backoff_s=0.001, max_backoff_s=0.004)
+    pf_eng = ServingEngine(CFG, _params(), **kw)
+    dc_eng = ServingEngine(
+        CFG, _params(), prefix_cache=True,
+        faults=FaultInjector().plan("step", at=1, kind="crash"), **kw)
+    mono = ServingEngine(CFG, _params(), **kw)
+
+    res = _ingest(dc_eng, _export_frame(pf_eng, prompt))
+    assert res["stored"], res["reason"]
+    req = Request(prompt=np.asarray(prompt, np.int32), max_new=6,
+                  done=threading.Event())
+    dc_eng.submit(req)
+    dc_eng.run()  # supervised loop: crash -> recover -> finish
+    assert dc_eng.metrics.n_restarts == 1
+    assert req.status == RequestStatus.FINISHED, req.error
+    np.testing.assert_array_equal(
+        dc_eng.pop_result(req.id), _gen(mono, prompt, max_new=6))
+
+
+# -- live fleet over HTTP -------------------------------------------------
+
+
+def _post(addr, path, body, headers=None, timeout=60):
+    import http.client
+
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers=h)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read()), r.getheader("X-Served-By")
+    finally:
+        conn.close()
+
+
+def _get(addr, path, timeout=10):
+    import http.client
+
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _prom_value(text: str, series: str) -> float:
+    """Value of one Prometheus sample line (series incl. labels)."""
+    for line in text.splitlines():
+        if line.startswith(series + " "):
+            return float(line.split()[-1])
+    raise AssertionError(f"{series} not found in exposition")
+
+
+def test_fleet_disagg_transfer_parity_stickiness_and_trace():
+    """Controller + 1 prefill + 1 decode replica, live over HTTP. A
+    long prompt takes the transfer path (prefill computes KV, pushes
+    the frame replica-to-replica, decode full-hits) and the output is
+    byte-identical to a monolithic server's. A session follow-up
+    sticks to the decode replica, a short prompt skips the transfer,
+    and the merged trace chains controller dispatch -> export prefill
+    -> transfer -> kv_ingest under one trace id."""
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2,
+              retry_backoff_s=0.001, max_backoff_s=0.004)
+    tr_pf = Tracer(process_name="serve-prefill")
+    tr_dc = Tracer(process_name="serve-decode")
+    pf_eng = ServingEngine(CFG, _params(), tracer=tr_pf, **kw)
+    dc_eng = ServingEngine(CFG, _params(), prefix_cache=True,
+                           tracer=tr_dc, **kw)
+    mono_eng = ServingEngine(CFG, _params(), **kw)
+    pf_srv = ServingServer(pf_eng, port=0).start()
+    dc_srv = ServingServer(dc_eng, port=0).start()
+    mono_srv = ServingServer(mono_eng, port=0).start()
+    tr_ctl = Tracer(process_name="controller")
+    ctl = FleetController(
+        [pf_srv.address + ("prefill",), dc_srv.address + ("decode",)],
+        disagg_threshold=12, affinity_min_match=4,
+        health_interval_s=0.1, tracer=tr_ctl,
+    ).start()
+    try:
+        prompt = [int(t) for t in
+                  np.random.default_rng(13).integers(1, 60, 16)]
+        status, body, served_by = _post(
+            ctl.address, "/v1/generate",
+            {"prompt": prompt, "max_new": 4, "session": "conv-1"})
+        assert status == 200, body
+        assert served_by == dc_srv.name  # decode role got the generate
+        assert dc_eng.prefill_dispatches == 0  # seated -> full hit
+        # per-request timing rides the relayed response: the bench's
+        # end-to-end TTFT (wall - decode_s) depends on these fields
+        assert body["timing"]["ttft_s"] >= 0.0
+        assert body["timing"]["decode_s"] >= 0.0
+        status, ref, _ = _post(mono_srv.address, "/v1/generate",
+                               {"prompt": prompt, "max_new": 4})
+        assert status == 200 and body["tokens"] == ref["tokens"]
+
+        # session follow-up: sticky to the decode replica, short
+        # prompt (< threshold) so no second transfer
+        status, _, served_by2 = _post(
+            ctl.address, "/v1/generate",
+            {"prompt": prompt[:8], "max_new": 2, "session": "conv-1"})
+        assert status == 200 and served_by2 == dc_srv.name
+
+        prom = ctl.registry.render()
+        assert _prom_value(prom, "fleet_disagg_total") == 1
+        assert _prom_value(prom, "fleet_sticky_total") == 1
+        assert _prom_value(prom, "fleet_transfer_fallback_total") == 0
+        # replica-side wire metrics: one export+transfer on the
+        # prefill replica, one stored ingest on the decode replica
+        _, ptext = _get(pf_srv.address, "/metrics")
+        ptext = ptext.decode()
+        assert _prom_value(ptext, "serve_kv_exports_total") == 1
+        assert _prom_value(
+            ptext, 'serve_transfers_total{result="ok"}') == 1
+        assert _prom_value(ptext, "serve_transfer_bytes_total") > 0
+        _, dtext = _get(dc_srv.address, "/metrics")
+        assert _prom_value(
+            dtext.decode(),
+            'serve_kv_ingests_total{result="stored"}') == 1
+    finally:
+        ctl.stop()
+        for s in (pf_srv, dc_srv, mono_srv):
+            s.stop()
+
+    merged = merge_traces([tr_ctl.chrome_trace(), tr_pf.chrome_trace(),
+                           tr_dc.chrome_trace()])
+    evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    by_span = {e["args"]["span_id"]: e for e in evs
+               if "span_id" in e.get("args", {})}
+    transfer = next(e for e in evs if e["name"] == "transfer")
+    export = by_span[transfer["args"]["parent_span_id"]]
+    assert export["name"] == "prefill"
+    assert export["args"]["prefix"] == "export"
+    ingest = next(e for e in evs if e["name"] == "kv_ingest")
+    assert ingest["args"]["parent_span_id"] == transfer["args"]["span_id"]
+    # one trace id end to end, rooted at a controller dispatch
+    tid = transfer["args"]["trace_id"]
+    assert ingest["args"]["trace_id"] == tid
+    assert export["args"]["trace_id"] == tid
+    dispatch = by_span[export["args"]["parent_span_id"]]
+    assert dispatch["name"] == "dispatch"
+    assert dispatch["args"]["leg"] == "prefill"
+
+
+def test_drain_undrain_rolls_through_a_two_replica_fleet():
+    """POST /fleet/drain flips the replica's /readyz, the controller
+    stops dispatching to it (traffic all lands on the survivor), and
+    /fleet/undrain restores the rotation — the rolling-restart
+    primitive, live over HTTP."""
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2,
+              retry_backoff_s=0.001, max_backoff_s=0.004)
+    servers = [ServingServer(ServingEngine(CFG, _params(), **kw),
+                             port=0).start() for _ in range(2)]
+    ctl = FleetController(
+        [s.address for s in servers],  # monolithic x2
+        health_interval_s=10.0,  # tests poll synchronously
+        rebalance_enabled=False,
+    ).start()
+    try:
+        victim, survivor = servers
+        status, body, _ = _post(ctl.address, "/fleet/drain",
+                                {"replica": victim.name})
+        assert status == 200 and body["draining"] is True
+        assert body["replica_response"]["in_flight"] == 0
+        code, _ = _get(victim.address, "/readyz")
+        assert code == 503  # drained replica reports not-ready
+        code, _ = _get(survivor.address, "/readyz")
+        assert code == 200
+
+        for i in range(3):
+            status, _, served_by = _post(
+                ctl.address, "/v1/generate",
+                {"prompt": [3, 5, 7, 11 + i], "max_new": 2})
+            assert status == 200
+            assert served_by == survivor.name  # never the draining one
+
+        status, body, _ = _post(ctl.address, "/fleet/undrain",
+                                {"replica": victim.name})
+        assert status == 200 and body["draining"] is False
+        code, _ = _get(victim.address, "/readyz")
+        assert code == 200
+        ctl.poll_health()
+        st = ctl.fleet_state()["replicas"]
+        assert st[victim.name]["draining"] is False
+        assert st[victim.name]["healthy"] is True
+        # the restored replica serves again when addressed directly
+        status, _, _ = _post(victim.address, "/v1/generate",
+                             {"prompt": [2, 4, 6, 8], "max_new": 2})
+        assert status == 200
+    finally:
+        ctl.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_server_drain_rejects_new_work_but_keeps_engine_alive():
+    eng = ServingEngine(CFG, _params(), n_slots=2, temperature=0.0,
+                        decode_horizon=2)
+    srv = ServingServer(eng, port=0).start()
+    try:
+        status, _, _ = _post(srv.address, "/drain", {})
+        assert status == 200
+        status, body, _ = _post(srv.address, "/v1/generate",
+                                {"prompt": [1, 2, 3], "max_new": 2})
+        assert status == 503, body
+        # idempotent; /undrain resumes the exact same server
+        _post(srv.address, "/drain", {})
+        status, _, _ = _post(srv.address, "/undrain", {})
+        assert status == 200
+        status, body, _ = _post(srv.address, "/v1/generate",
+                                {"prompt": [1, 2, 3], "max_new": 2})
+        assert status == 200, body
+    finally:
+        srv.stop()
+
+
+# -- router re-verifies model identity on replica return ------------------
+
+
+class _FakeReplica:
+    """A bare /healthz endpoint whose payload the test scripts — the
+    'replica restarted with a different checkpoint' scenario without
+    paying for a second engine."""
+
+    def __init__(self):
+        fake = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                if fake.down:
+                    self.close_connection = True
+                    return
+                send_json(self, 200, {
+                    "ok": True, "draining": fake.draining,
+                    "config_hash": fake.config_hash, "queue_depth": 0,
+                })
+
+        self.down = False
+        self.draining = False
+        self.config_hash = "a" * 64
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self):
+        return self._httpd.server_address[:2]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def test_router_marks_restarted_replica_incompatible():
+    fake = _FakeReplica()
+    router = ReplicaRouter([fake.address], health_interval_s=10.0)
+    name = "%s:%d" % fake.address
+    try:
+        router.poll_health()  # first contact pins the config hash
+        st = router.replica_states()[name]
+        assert st["healthy"] and not st["incompatible"]
+        assert st["config_hash"] == "a" * 64
+
+        fake.down = True  # "restart": goes dark ...
+        router.poll_health()
+        assert not router.replica_states()[name]["healthy"]
+
+        fake.down = False  # ... and returns with a DIFFERENT checkpoint
+        fake.config_hash = "b" * 64
+        router.poll_health()
+        st = router.replica_states()[name]
+        assert st["incompatible"], st
+        assert st["config_hash"] == "a" * 64  # the pinned identity
+        # permanently out of rotation, not silently rejoined: the
+        # fake's /healthz says ok but routing refuses the replica
+        status, payload, served = router.route(
+            {"prompt": [1, 2, 3], "max_new": 1})
+        assert status == 503 and served is None
+    finally:
+        router._httpd.server_close()  # never start()ed
+        fake.stop()
+
+
+def test_router_respects_replica_draining_flag():
+    fake = _FakeReplica()
+    router = ReplicaRouter([fake.address], health_interval_s=10.0)
+    name = "%s:%d" % fake.address
+    try:
+        fake.draining = True
+        router.poll_health()
+        st = router.replica_states()[name]
+        assert st["healthy"] and st["draining"]
+        fake.draining = False
+        router.poll_health()
+        assert not router.replica_states()[name]["draining"]
+    finally:
+        router._httpd.server_close()  # never start()ed
+        fake.stop()
+
+
+def test_controller_rejects_bad_specs_and_unknown_fleet_posts():
+    with pytest.raises(ValueError):
+        FleetController([])
+    with pytest.raises(ValueError):
+        FleetController(["localhost:notaport"])
+    with pytest.raises(ValueError):
+        FleetController(["localhost:8000=chef"])
+    ctl = FleetController(["127.0.0.1:1=prefill",
+                           "127.0.0.1:2=decode"]).start()
+    try:
+        status, body, _ = _post(ctl.address, "/fleet/drain",
+                                {"replica": "nobody:9"})
+        assert status == 404
+        status, body, _ = _post(ctl.address, "/fleet/role",
+                                {"replica": "127.0.0.1:1",
+                                 "role": "chef"})
+        assert status == 400
+        status, body, _ = _post(
+            ctl.address, "/fleet/role",
+            {"replica": "127.0.0.1:1", "role": "decode"})
+        assert status == 200
+        assert ctl.fleet_state()["replicas"]["127.0.0.1:1"]["role"] == \
+            "decode"
+    finally:
+        ctl.stop()
