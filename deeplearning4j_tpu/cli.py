@@ -585,6 +585,7 @@ def cmd_serve(args) -> int:
         hang_threshold_s=args.hang_threshold,
         metrics_port=args.metrics_port,
         flight_dir=args.flight_dir,
+        migrate_targets=tuple(args.migrate_target or ()),
     )
     host, port = server.address
     # name the process track after the bound address so trace-merge
@@ -887,6 +888,10 @@ def cmd_controller(args) -> int:
                 dwell_s=args.rebalance_dwell,
             ),
             rebalance_enabled=not args.no_rebalance,
+            hedge_enabled=not args.no_hedge,
+            journal=args.journal,
+            standby_of=args.standby_of,
+            failover_after=args.failover_after,
             tracer=tracer,
             flight_dir=args.flight_dir,
         )
@@ -1177,6 +1182,12 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("--max-restarts", type=int, default=5,
                    help="consecutive engine-crash recoveries before "
                    "the server declares the engine dead (/healthz 503)")
+    v.add_argument("--migrate-target", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="peer replica eligible to re-seat this "
+                   "replica's in-flight sessions on drain (POST "
+                   "/migrate also accepts explicit targets); repeat "
+                   "per peer")
     v.add_argument("--chaos-rate", type=float, default=0.0,
                    help="inject transient faults at engine boundaries "
                    "at this per-step probability (smoke-tests the "
@@ -1360,6 +1371,22 @@ def main(argv: list[str] | None = None) -> int:
     c.add_argument("--no-rebalance", action="store_true",
                    help="disable automatic role rebalancing (roles "
                    "still movable via POST /fleet/role)")
+    c.add_argument("--no-hedge", action="store_true",
+                   help="disable hedged second attempts on the "
+                   "idempotent KV-transfer leg (generate legs are "
+                   "never hedged)")
+    c.add_argument("--journal", default=None, metavar="PATH",
+                   help="journal roles/stickiness/breaker state to "
+                   "PATH (atomic rewrite) so a warm standby can take "
+                   "over after a controller crash")
+    c.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                   help="run as a warm standby: answer 503 to all "
+                   "traffic while watching the primary controller at "
+                   "HOST:PORT; promote from --journal after "
+                   "--failover-after consecutive missed health checks")
+    c.add_argument("--failover-after", type=int, default=3,
+                   help="consecutive missed primary health checks "
+                   "before a standby promotes itself")
     c.add_argument("--trace-out", default=None, metavar="PATH",
                    help="enable the controller's dispatch tracer and "
                    "write its Chrome-trace/Perfetto JSON to PATH on "

@@ -76,14 +76,23 @@ from deeplearning4j_tpu.serving.faults import (  # noqa: F401
     TransientFault,
 )
 from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from deeplearning4j_tpu.serving.netfaults import ChaosProxy  # noqa: F401
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.serving.router import ReplicaRouter  # noqa: F401
+from deeplearning4j_tpu.serving.rpc import (  # noqa: F401
+    CircuitBreaker,
+    Deadline,
+    IdempotencyRegistry,
+    LatencyWindow,
+    run_hedged,
+)
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Backpressure,
     EmbeddingRequest,
     KVExportRequest,
     KVIngestRequest,
+    KVSessionRequest,
     Request,
     RequestScheduler,
     RequestStatus,

@@ -45,7 +45,25 @@ Rolling restarts ride ``POST /fleet/drain`` / ``/fleet/undrain``
 (body ``{"replica": "host:port"}``): the controller relays the
 replica's own ``/drain`` endpoint and stops dispatching to it
 immediately; in-flight work finishes because the replica keeps
-stepping. ``/undrain`` restores it to the rotation.
+stepping. ``/undrain`` restores it to the rotation. With ``{"migrate":
+true}`` the drain additionally relays the replica's ``/migrate`` so
+live sessions finish on a healthy decode replica (byte-identical —
+see ``ServingEngine.export_sessions``) instead of riding out the
+drain.
+
+RESILIENCE (PR 17): every outbound leg honors the caller's
+``X-Deadline-Ms`` budget (shrunken and re-forwarded per hop, socket
+timeouts derived from it); per-replica CIRCUIT BREAKERS
+(closed/open/half-open with exponential probe backoff,
+:class:`~deeplearning4j_tpu.serving.rpc.CircuitBreaker`) gate dispatch
+— a health-poll success alone never closes an open breaker, only a
+successful probe request does; the idempotent transfer leg is HEDGED
+after the observed p99 transfer latency (the decode replica dedups on
+the shared idempotency key; the generate leg is never hedged). The
+controller checkpoints roles / sticky sessions / breaker state to a
+JOURNAL (atomic rename), and a warm standby (``standby_of=``)
+promotes from that journal after ``failover_after`` missed primary
+probes, re-verifying against live fleet state.
 
 The controller is the fleet's trace root: every outbound leg (prefill
 dispatch, decode dispatch) is a real span carrying a fresh span id
@@ -85,6 +103,15 @@ from deeplearning4j_tpu.obs.trace import (
     parse_traceparent,
 )
 from deeplearning4j_tpu.serving.router import PrefixShadow, _ReplicaDown
+from deeplearning4j_tpu.serving.rpc import (
+    CLOSED,
+    DEADLINE_HEADER,
+    HALF_OPEN,
+    CircuitBreaker,
+    Deadline,
+    LatencyWindow,
+    run_hedged,
+)
 from deeplearning4j_tpu.utils.httpjson import (
     QuietHandler,
     read_json_body,
@@ -187,7 +214,7 @@ class _Member:
     __slots__ = ("host", "port", "role", "role_since", "healthy",
                  "draining", "incompatible", "config_hash", "in_flight",
                  "routed", "queue_depth", "slo_burn", "shadow",
-                 "last_health")
+                 "last_health", "breaker")
 
     def __init__(self, host: str, port: int, role: str = "monolithic"):
         if role not in ROLES:
@@ -206,6 +233,17 @@ class _Member:
         self.slo_burn = 0.0
         self.shadow = PrefixShadow()
         self.last_health: dict | None = None
+        # per-replica circuit breaker; dispatch gates on it (the binary
+        # healthy flag above stays as the liveness VIEW, the breaker is
+        # what decides). The controller replaces this with one wired to
+        # its transition hooks.
+        self.breaker = CircuitBreaker()
+
+    def dispatchable(self) -> bool:  # lint: holds _route_lock
+        """Usable AND the breaker is closed — the fast path. Open or
+        half-open breakers only admit the explicit probe picked in
+        ``_pick_decode``/``_pick_prefills``."""
+        return self.usable() and self.breaker.state == CLOSED
 
     @property
     def name(self) -> str:
@@ -229,6 +267,7 @@ class _Member:
             "queue_depth": self.queue_depth,
             "slo_burn": self.slo_burn,
             "shadow_nodes": len(self.shadow),
+            "breaker": self.breaker.snapshot(),
         }
 
 
@@ -266,7 +305,11 @@ class FleetController:
                  session_cap: int = 65536,
                  tracer: Tracer | None = None,
                  flight: FlightRecorder | None = None,
-                 flight_dir: str | None = None):
+                 flight_dir: str | None = None,
+                 hedge_enabled: bool = True,
+                 journal: str | None = None,
+                 standby_of: str | None = None,
+                 failover_after: int = 3):
         if not replicas:
             raise ValueError("need at least one replica")
         self.members = [_parse_member(spec) for spec in replicas]
@@ -291,6 +334,22 @@ class FleetController:
         # whose replica died just falls back to shadow affinity
         self._sessions: OrderedDict[str, str] = OrderedDict()
         self._session_cap = int(session_cap)
+        # hedging: the transfer leg is idempotent (the decode replica
+        # dedups on the shared idempotency key), so a second attempt
+        # fires after the observed p99 transfer latency
+        self.hedge_enabled = bool(hedge_enabled)
+        self._transfer_lat = LatencyWindow()
+        # checkpoint + failover: the journal captures roles, session
+        # stickiness (LRU order), breaker state, and config hashes so a
+        # warm standby promotes from disk and re-verifies against live
+        # /fleet state instead of starting cold
+        self.journal_path = Path(journal) if journal else None
+        self._journal_seq = 0
+        self.standby_of = standby_of or None
+        self.failover_after = max(1, int(failover_after))
+        self._primary_misses = 0
+        # standby controllers route nothing until promoted
+        self._active = self.standby_of is None
 
         reg = self.registry = MetricsRegistry()
         self._m_requests = reg.counter(
@@ -327,8 +386,40 @@ class FleetController:
         self._m_healthy = reg.gauge(
             "fleet_replica_healthy", "1 while the replica is usable.",
             labelnames=("replica",))
+        self._m_breaker = reg.gauge(
+            "fleet_breaker_state",
+            "Circuit breaker per replica: 0 closed, 0.5 half-open, "
+            "1 open.",
+            labelnames=("replica",))
+        self._m_breaker_transitions = reg.counter(
+            "fleet_breaker_transitions_total",
+            "Breaker state changes, per replica and new state.",
+            labelnames=("replica", "state"))
+        self._m_hedges = reg.counter(
+            "fleet_hedges_total",
+            "Hedged transfer legs, by result (fired = second attempt "
+            "launched, won = second attempt answered first).",
+            labelnames=("result",))
+        self._m_sessions_evicted = reg.counter(
+            "fleet_sessions_evicted_total",
+            "Sticky sessions dropped by LRU eviction at session_cap.")
+        self._m_failovers = reg.counter(
+            "fleet_failovers_total",
+            "Standby promotions after losing the primary.")
+        self._m_migrations = reg.counter(
+            "fleet_migrations_total",
+            "Live session migrations relayed on drain, by result.",
+            labelnames=("result",))
+        self._m_standby = reg.gauge(
+            "fleet_standby", "1 while this controller is a standby.")
+        self._m_standby.set(0.0 if self._active else 1.0)
         for m in self.members:
             self._m_healthy.set(1.0, replica=m.name)
+            self._m_breaker.set(0.0, replica=m.name)
+            # rewire each member's breaker through the controller's
+            # transition hook (flight event + gauge + journal)
+            m.breaker = CircuitBreaker(
+                on_transition=self._breaker_hook(m.name))
         self._refresh_role_gauges()
 
         controller = self
@@ -355,6 +446,12 @@ class FleetController:
                 if controller._stop.is_set():
                     send_json(self, 503, {"error": "controller stopped"})
                     return
+                if not controller._active:
+                    # a standby routes nothing until promoted; callers
+                    # retry against the primary (or wait for failover)
+                    send_json(self, 503, {"error": "standby controller",
+                                          "standby": True})
+                    return
                 if path in ("/fleet/drain", "/fleet/undrain",
                             "/fleet/role"):
                     body = read_json_body(self)
@@ -371,7 +468,8 @@ class FleetController:
                     send_json(self, 400, {"error": "malformed JSON"})
                     return
                 code, payload, served_by = controller.route(
-                    body, traceparent=self.headers.get("traceparent"))
+                    body, traceparent=self.headers.get("traceparent"),
+                    deadline_ms=self.headers.get(DEADLINE_HEADER))
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
@@ -413,19 +511,30 @@ class FleetController:
             self._sessions[key] = name
             self._sessions.move_to_end(key)
             while len(self._sessions) > self._session_cap:
-                self._sessions.popitem(last=False)
+                evicted, _ = self._sessions.popitem(last=False)
+                self._m_sessions_evicted.inc()
+                log_event(_log, "fleet_session_evicted",
+                          session=evicted, cap=self._session_cap)
 
     def _pick_decode(self, tokens, session,
                      exclude: set[str]) -> tuple[_Member, str]:
         """Choose the decode-capable target; returns ``(member, how)``
         with ``how`` in sticky/affinity/load. Raises ``_ReplicaDown``
-        when no usable candidate remains."""
+        when no usable candidate remains. Breaker-gated: closed
+        breakers are the normal pool; when it is empty, ONE due probe
+        through an open breaker is admitted (half-open) so a recovered
+        replica can prove itself on real traffic."""
         with self._route_lock:
-            candidates = [
+            avail = [
                 m for m in self.members
                 if m.usable() and m.decode_capable()
                 and m.name not in exclude
             ]
+            candidates = [m for m in avail if m.breaker.state == CLOSED]
+            if not candidates:
+                # allow() consumes the half-open probe, so only ask
+                # when no closed-breaker replica remains
+                candidates = [m for m in avail if m.breaker.allow()]
             if not candidates:
                 raise _ReplicaDown("no usable decode replica")
             chosen, how = None, "load"
@@ -436,6 +545,10 @@ class FleetController:
                     for m in candidates:
                         if m.name == want:
                             chosen, how = m, "sticky"
+                            # a sticky HIT refreshes LRU recency, so
+                            # active sessions outlive idle pins at the
+                            # eviction cap
+                            self._sessions.move_to_end(str(session))
                             break
             if chosen is None and tokens:
                 best, best_match = None, -1
@@ -459,21 +572,27 @@ class FleetController:
                 chosen.shadow.insert(tokens)
             return chosen, how
 
-    def _pick_prefill(self, decode_name: str) -> _Member | None:
-        """Least-loaded usable DEDICATED prefill replica (monolithic
-        replicas prefill for themselves; shipping KV from one decode
-        replica to another buys nothing). None when the fleet has no
-        transfer path — the caller falls back to local prefill."""
+    def _pick_prefills(self, decode_name: str) -> list[_Member]:
+        """Usable DEDICATED prefill replicas, least-loaded first
+        (monolithic replicas prefill for themselves; shipping KV from
+        one decode replica to another buys nothing). Entry [0] is the
+        primary transfer target; entry [1], when present, is the hedge
+        destination. Empty when the fleet has no transfer path — the
+        caller falls back to local prefill. Breaker-gated like
+        ``_pick_decode``."""
         with self._route_lock:
-            candidates = [
+            avail = [
                 m for m in self.members
                 if m.usable() and m.role == "prefill"
                 and m.name != decode_name
             ]
+            candidates = [m for m in avail if m.breaker.state == CLOSED]
             if not candidates:
-                return None
-            lo = min(m.in_flight for m in candidates)
-            return next(m for m in candidates if m.in_flight == lo)
+                candidates = [m for m in avail if m.breaker.allow()]
+            ranked = sorted(
+                (m.in_flight, i, m) for i, m in enumerate(candidates)
+            )
+            return [m for _, _, m in ranked]
 
     def _span(self, name: str, trace_id: str, span_id: str,
               parent_span: str, t0: float, **extra) -> None:
@@ -485,73 +604,134 @@ class FleetController:
         self.tracer.span(CONTROLLER_TRACK, name, t0,
                          time.perf_counter() - t0, **args)
 
-    def _transfer_leg(self, prefill: _Member, target: _Member,
+    def _transfer_leg(self, prefills: list[_Member], target: _Member,
                       body: dict, tokens, trace_id: str,
-                      parent_span: str) -> bool:
-        """The disagg leg: ask ``prefill`` to compute the prompt's KV
-        and push the segment to ``target``. True only when the segment
-        was pushed AND seated — anything else means the forwarded
-        generate will prefill locally (same bytes, just slower)."""
-        req = {"prompt": tokens, "push_to": target.name}
+                      parent_span: str,
+                      dl: Deadline | None = None) -> bool:
+        """The disagg leg: ask a prefill replica to compute the
+        prompt's KV and push the segment to ``target``. True only when
+        the segment was pushed AND seated — anything else means the
+        forwarded generate will prefill locally (same bytes, just
+        slower).
+
+        Idempotent end to end (the decode replica dedups the push on
+        the shared ``idem_key``), so with a second prefill candidate
+        the leg is HEDGED: if the first attempt hasn't answered within
+        the observed p99 transfer latency, a second fires at the
+        alternate replica and the first completion wins. The loser's
+        push is declined by the dedup (409) — which counts as success
+        here, since the segment IS seated — at the price of one wasted
+        prefill."""
+        idem_key = "tx-" + new_span_id()
+        req = {"prompt": tokens, "push_to": target.name,
+               "idem_key": idem_key}
         for k in ("priority", "adapter"):
             if k in body:
                 req[k] = body[k]
-        span_id = new_span_id()
-        t0 = time.perf_counter()
-        ok, info, err = False, {}, None
-        with self._route_lock:
-            prefill.in_flight += 1
-        try:
-            conn = http.client.HTTPConnection(
-                prefill.host, prefill.port,
-                timeout=self.request_timeout_s)
+        raw = json.dumps(req).encode()
+
+        def attempt(leg: int):
+            prefill = prefills[leg % len(prefills)]
+            span_id = new_span_id()
+            t0 = time.perf_counter()
+            ok, info, err = False, {}, None
+            with self._route_lock:
+                prefill.in_flight += 1
             try:
-                conn.request(
-                    "POST", "/v1/prefill", body=json.dumps(req).encode(),
-                    headers={
+                conn = http.client.HTTPConnection(
+                    prefill.host, prefill.port,
+                    timeout=(dl.timeout(self.request_timeout_s)
+                             if dl is not None
+                             else self.request_timeout_s))
+                try:
+                    headers = {
                         "Content-Type": "application/json",
                         "traceparent": format_traceparent(
                             trace_id, span_id),
                         "X-Served-By": prefill.name,
-                    })
-                resp = conn.getresponse()
-                raw = resp.read()
-                try:
-                    info = json.loads(raw.decode("utf-8"))
-                except (ValueError, UnicodeDecodeError):
-                    info = {}
-                if resp.status == 503:
-                    raise _ReplicaDown(f"{prefill.name} answered 503")
-                ok = resp.status == 200 and bool(info.get("pushed"))
-                if not ok:
-                    err = "http %d pushed=%s" % (
-                        resp.status, info.get("pushed"))
+                    }
+                    if dl is not None:
+                        headers[DEADLINE_HEADER] = dl.header_value()
+                    conn.request("POST", "/v1/prefill", body=raw,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    try:
+                        info = json.loads(payload.decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        info = {}
+                    if resp.status == 503:
+                        raise _ReplicaDown(f"{prefill.name} answered 503")
+                    ok = resp.status == 200 and bool(info.get("pushed"))
+                    if (not ok and isinstance(info.get("ingest"), dict)
+                            and info["ingest"].get("duplicate")):
+                        # the other hedge leg's copy seated first —
+                        # same bytes are in the decode replica's cache
+                        ok = True
+                    prefill.breaker.record_success()
+                    if not ok:
+                        err = "http %d pushed=%s" % (
+                            resp.status, info.get("pushed"))
+                except (OSError, http.client.HTTPException,
+                        _ReplicaDown) as e:
+                    err = str(e)
+                    self._mark_unhealthy(prefill, err)
+                    raise
+                finally:
+                    conn.close()
             finally:
-                conn.close()
-        except (OSError, http.client.HTTPException, _ReplicaDown) as e:
+                with self._route_lock:
+                    prefill.in_flight -= 1
+                dt = time.perf_counter() - t0
+                self._transfer_lat.record(dt)
+                self._span("dispatch", trace_id, span_id, parent_span,
+                           t0, leg="prefill", replica=prefill.name,
+                           ok=ok)
+            return ok, err, prefill.name
+
+        hedge = (self.hedge_enabled and len(prefills) >= 2
+                 and (dl is None or not dl.expired()))
+        ok, err, via = False, None, prefills[0].name
+
+        def on_hedge():
+            self._m_hedges.inc(result="fired")
+            self.flight.record("hedge_fired", leg="transfer",
+                               trace_id=trace_id,
+                               primary=prefills[0].name,
+                               hedge=prefills[1].name)
+
+        try:
+            if hedge:
+                result, legs, winner = run_hedged(
+                    attempt, delay_s=self._transfer_lat.quantile(0.99),
+                    deadline=dl, on_hedge=on_hedge)
+                ok, err, via = result
+                if legs > 1 and winner == 1:
+                    self._m_hedges.inc(result="won")
+                    self.flight.record("hedge_won", leg="transfer",
+                                       trace_id=trace_id, replica=via)
+            else:
+                ok, err, via = attempt(0)
+        except (_ReplicaDown, OSError, http.client.HTTPException) as e:
             err = str(e)
-            self._mark_unhealthy(prefill, err)
-        finally:
-            with self._route_lock:
-                prefill.in_flight -= 1
-        self._span("dispatch", trace_id, span_id, parent_span, t0,
-                   leg="prefill", replica=prefill.name, ok=ok)
         if ok:
             self._m_disagg.inc()
         else:
             self._m_fallback.inc()
             log_event(_log, "fleet_transfer_fallback",
-                      prefill=prefill.name, decode=target.name,
+                      prefill=via, decode=target.name,
                       error=err, trace_id=trace_id)
-        self.flight.record("transfer", prefill=prefill.name,
+        self.flight.record("transfer", prefill=via,
                            decode=target.name, ok=ok,
                            trace_id=trace_id)
         return ok
 
-    def _forward(self, member: _Member, raw: bytes,
-                 headers: dict) -> tuple[int, bytes]:
+    def _forward(self, member: _Member, raw: bytes, headers: dict,
+                 dl: Deadline | None = None) -> tuple[int, bytes]:
         conn = http.client.HTTPConnection(
-            member.host, member.port, timeout=self.request_timeout_s)
+            member.host, member.port,
+            timeout=(dl.timeout(self.request_timeout_s)
+                     if dl is not None else self.request_timeout_s))
         try:
             conn.request("POST", "/v1/generate", body=raw,
                          headers=headers)
@@ -559,6 +739,7 @@ class FleetController:
             payload = resp.read()
             if resp.status == 503:
                 raise _ReplicaDown(f"{member.name} answered 503")
+            member.breaker.record_success()
             return resp.status, payload
         except (OSError, http.client.HTTPException) as e:
             raise _ReplicaDown(f"{member.name}: {e}") from e
@@ -566,7 +747,8 @@ class FleetController:
             conn.close()
 
     def route(self, body: dict,
-              traceparent: str | None = None
+              traceparent: str | None = None,
+              deadline_ms: str | None = None
               ) -> tuple[int, bytes, str | None]:
         """Route one generate request; returns
         ``(status, payload_bytes, replica_name | None)``.
@@ -575,10 +757,17 @@ class FleetController:
         if the decode replica then dies before accepting the generate,
         the retry on a survivor skips re-transfer — the survivor
         prefills locally, which is the universal fallback anyway.
+
+        Every leg's socket timeout and the shrunken ``X-Deadline-Ms``
+        forwarded downstream derive from the caller's deadline budget
+        (default: the controller's own request timeout). The generate
+        leg itself is never hedged — decoding is not idempotent.
         """
         self._m_requests.inc()
         ctx = parse_traceparent(traceparent)
         trace_id, parent_span = ctx if ctx else (new_trace_id(), "")
+        dl = Deadline.from_header(deadline_ms,
+                                  default_s=self.request_timeout_s)
         tokens = self._prompt_tokens(body)
         session = body.get("session")
         raw = json.dumps(body).encode()
@@ -586,6 +775,12 @@ class FleetController:
         attempt = 0
         transfer_tried = False
         while True:
+            if dl.expired():
+                # budget gone: a bounded clean failure beats a forward
+                # the caller will never read
+                return 504, json.dumps(
+                    {"error": "deadline exhausted",
+                     "attempts": attempt}).encode(), None
             try:
                 member, how = self._pick_decode(tokens, session, exclude)
             except _ReplicaDown:
@@ -603,15 +798,16 @@ class FleetController:
             if (not transfer_tried
                     and len(tokens) >= self.disagg_threshold):
                 transfer_tried = True
-                prefill = self._pick_prefill(member.name)
-                if prefill is not None:
-                    self._transfer_leg(prefill, member, body, tokens,
-                                       trace_id, parent_span)
+                prefills = self._pick_prefills(member.name)
+                if prefills:
+                    self._transfer_leg(prefills, member, body, tokens,
+                                       trace_id, parent_span, dl)
             span_id = new_span_id()
             headers = {
                 "Content-Type": "application/json",
                 "traceparent": format_traceparent(trace_id, span_id),
                 "X-Served-By": member.name,
+                DEADLINE_HEADER: dl.header_value(),
             }
             if self.flight.enabled:
                 self.flight.record("dispatch", replica=member.name,
@@ -619,7 +815,7 @@ class FleetController:
                                    trace_id=trace_id)
             t0 = time.perf_counter()
             try:
-                status, payload = self._forward(member, raw, headers)
+                status, payload = self._forward(member, raw, headers, dl)
                 self._span("dispatch", trace_id, span_id, parent_span,
                            t0, leg="decode", replica=member.name,
                            attempt=attempt, how=how, status=status)
@@ -673,10 +869,70 @@ class FleetController:
             now_draining = member.draining
         log_event(_log, "fleet_drain" if draining else "fleet_undrain",
                   replica=name, relayed=ok)
-        send_json(handler, 200 if ok else 502, {
+        out = {
             "replica": name, "draining": now_draining,
             "relayed": ok, "replica_response": info,
-        })
+        }
+        if draining and ok and body.get("migrate"):
+            # drain-with-migration: once the replica stops admitting,
+            # relay its /migrate so live sessions finish elsewhere
+            # instead of riding out the drain (or dying with it)
+            out["migration"] = self._migrate_replica(member)
+        self._write_journal()
+        send_json(handler, 200 if ok else 502, out)
+
+    def _migrate_replica(self, member: _Member) -> dict:
+        """Relay ``member``'s ``POST /migrate`` with every OTHER
+        usable decode-capable replica (closed breakers only — a
+        migrating session must not probe a suspect replica) as the
+        target list. Failure is soft: sessions that do not seat stay
+        on the replica's ordinary drain path."""
+        with self._route_lock:
+            targets = [
+                m.name for m in self.members
+                if m is not member and m.dispatchable()
+                and m.decode_capable()
+            ]
+        if not targets:
+            self._m_migrations.inc(result="no_target")
+            return {"error": "no migration targets"}
+        info: dict = {}
+        err = None
+        try:
+            conn = http.client.HTTPConnection(
+                member.host, member.port,
+                timeout=self.request_timeout_s)
+            try:
+                conn.request(
+                    "POST", "/migrate",
+                    body=json.dumps({"targets": targets}).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                raw = resp.read()
+                try:
+                    info = json.loads(raw.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    info = {}
+                if resp.status != 200 and "error" not in info:
+                    err = f"http {resp.status}"
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            err = str(e)
+        if err:
+            info = dict(info)
+            info["error"] = err
+        result = ("ok" if not info.get("error")
+                  and not info.get("failed") else "failed")
+        self._m_migrations.inc(result=result)
+        self.flight.record("migration", replica=member.name,
+                           result=result,
+                           migrated=info.get("migrated"),
+                           failed=info.get("failed"))
+        log_event(_log, "fleet_migration", replica=member.name,
+                  result=result, migrated=info.get("migrated"),
+                  failed=info.get("failed"), error=info.get("error"))
+        return info
 
     def _relay_drain(self, member: _Member,
                      draining: bool) -> tuple[bool, dict]:
@@ -713,6 +969,7 @@ class FleetController:
         self._refresh_role_gauges()
         log_event(_log, "fleet_role_change", replica=member.name,
                   old=old, new=role, why=why)
+        self._write_journal()
 
     def _refresh_role_gauges(self) -> None:
         counts = {r: 0 for r in ROLES}
@@ -742,7 +999,24 @@ class FleetController:
     # health                                                         #
     # ------------------------------------------------------------- #
 
+    def _breaker_hook(self, name: str):
+        """Transition listener for one replica's breaker: gauge,
+        counter, and flight event per state change. Fires inside the
+        breaker's own lock, so it must stay cheap and must not take
+        ``_route_lock``."""
+        def hook(old: str, new: str) -> None:
+            self._m_breaker.set(
+                {CLOSED: 0.0, HALF_OPEN: 0.5}.get(new, 1.0),
+                replica=name)
+            self._m_breaker_transitions.inc(replica=name, state=new)
+            self.flight.record("breaker", replica=name,
+                               old=old, new=new)
+            log_event(_log, "fleet_breaker", replica=name,
+                      old=old, new=new)
+        return hook
+
     def _mark_unhealthy(self, member: _Member, why: str) -> None:
+        member.breaker.record_failure()
         with self._route_lock:
             note_access(f"controller.{member.name}.healthy", write=True)
             flipped = member.healthy
@@ -837,11 +1111,140 @@ class FleetController:
         for m in self.members:
             self._poll_one(m)
         self._maybe_rebalance()
+        if self._active:
+            self._write_journal()
 
     def _health_loop(self) -> None:
         while not self._stop.is_set():
-            self.poll_health()
+            if self._active:
+                self.poll_health()
+            else:
+                self._watch_primary()
             self._stop.wait(self.health_interval_s)
+
+    # ------------------------------------------------------------- #
+    # journal + standby failover                                     #
+    # ------------------------------------------------------------- #
+
+    def _write_journal(self) -> None:
+        """Checkpoint controller state (roles, sticky sessions in LRU
+        order, breaker snapshots, config hashes, drain flags) with an
+        atomic tmp+rename so the standby never reads a torn file."""
+        if self.journal_path is None:
+            return
+        with self._route_lock:
+            note_access("controller._sessions", write=True)
+            self._journal_seq += 1
+            state = {
+                "seq": self._journal_seq,
+                "ts": time.time(),
+                "controller": self.name,
+                "roles": {m.name: m.role for m in self.members},
+                "draining": [m.name for m in self.members if m.draining],
+                "config_hashes": {
+                    m.name: m.config_hash for m in self.members
+                    if m.config_hash
+                },
+                "breakers": {
+                    m.name: m.breaker.snapshot() for m in self.members
+                },
+                "sessions": list(self._sessions.items()),
+            }
+        tmp = self.journal_path.with_name(self.journal_path.name + ".tmp")
+        try:
+            tmp.write_text(json.dumps(state, sort_keys=True))
+            os.replace(tmp, self.journal_path)
+        except OSError as e:
+            log_event(_log, "fleet_journal_write_failed", error=repr(e),
+                      level=logging.ERROR)
+
+    def restore_journal(self) -> bool:
+        """Load the journal written by the (former) primary: roles,
+        session stickiness, breaker state, expected config hashes.
+        Returns False (and starts from the constructor state) when the
+        journal is absent or unreadable — failover still works, it
+        just loses stickiness and breaker history."""
+        if self.journal_path is None or not self.journal_path.exists():
+            return False
+        try:
+            state = json.loads(self.journal_path.read_text())
+        except (OSError, ValueError) as e:
+            log_event(_log, "fleet_journal_unreadable", error=repr(e),
+                      level=logging.ERROR)
+            return False
+        with self._route_lock:
+            note_access("controller._sessions", write=True)
+            for name, role in (state.get("roles") or {}).items():
+                m = self._member(str(name))
+                if m is not None and role in ROLES:
+                    m.role = str(role)
+            for name in state.get("draining") or ():
+                m = self._member(str(name))
+                if m is not None:
+                    m.draining = True
+            for name, cfg in (state.get("config_hashes") or {}).items():
+                m = self._member(str(name))
+                if m is not None and m.config_hash is None:
+                    m.config_hash = str(cfg)
+            for name, snap in (state.get("breakers") or {}).items():
+                m = self._member(str(name))
+                if m is not None and isinstance(snap, dict):
+                    m.breaker.restore(snap)
+            self._sessions.clear()
+            for pair in state.get("sessions") or ():
+                if isinstance(pair, (list, tuple)) and len(pair) == 2:
+                    self._sessions[str(pair[0])] = str(pair[1])
+            self._journal_seq = int(state.get("seq") or 0)
+        self._refresh_role_gauges()
+        log_event(_log, "fleet_journal_restored",
+                  seq=self._journal_seq,
+                  sessions=len(self._sessions))
+        return True
+
+    def _watch_primary(self) -> None:
+        """Standby mode: probe the primary's ``/healthz``;
+        ``failover_after`` consecutive misses promote this standby."""
+        host, _, port = str(self.standby_of).rpartition(":")
+        ok = False
+        try:
+            conn = http.client.HTTPConnection(
+                host or "127.0.0.1", int(port),
+                timeout=max(0.25, self.health_interval_s))
+            try:
+                conn.request("GET", "/healthz")
+                ok = conn.getresponse().status < 500
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException, ValueError):
+            ok = False
+        if ok:
+            self._primary_misses = 0
+            return
+        self._primary_misses += 1
+        if self._primary_misses >= self.failover_after:
+            self.promote()
+
+    def promote(self) -> None:
+        """Standby -> primary: restore the journal, then RE-VERIFY
+        against live fleet state with a full health sweep — the live
+        ``/healthz``/``/metrics.json`` answers override anything stale
+        in the journal (drain flags, queue depths, a replica that died
+        since the last checkpoint)."""
+        if self._active:
+            return
+        restored = self.restore_journal()
+        self._active = True
+        self._primary_misses = 0
+        self._m_standby.set(0.0)
+        self._m_failovers.inc()
+        self.flight.record("failover", controller=self.name,
+                           primary=self.standby_of,
+                           journal_restored=restored,
+                           journal_seq=self._journal_seq)
+        log_event(_log, "fleet_failover", controller=self.name,
+                  primary=self.standby_of, journal_restored=restored,
+                  journal_seq=self._journal_seq, level=logging.WARNING)
+        self.poll_health()
 
     def health_payload(self) -> dict:
         with self._route_lock:
@@ -849,7 +1252,9 @@ class FleetController:
             decode = [m.name for m in self.members
                       if m.usable() and m.decode_capable()]
             return {
-                "ok": bool(decode),
+                "ok": self._active and bool(decode),
+                "active": self._active,
+                "standby_of": self.standby_of,
                 "usable": usable,
                 "roles": {m.name: m.role for m in self.members},
                 "disagg_threshold": self.disagg_threshold,
@@ -861,6 +1266,8 @@ class FleetController:
                 "replicas": {m.name: m.state() for m in self.members},
                 "sessions": len(self._sessions),
                 "disagg_threshold": self.disagg_threshold,
+                "active": self._active,
+                "journal_seq": self._journal_seq,
             }
 
     # ------------------------------------------------------------- #

@@ -45,7 +45,10 @@ WIRE_MAGIC = b"KVSG"
 #: wire format version; bumped on ANY header or payload layout change.
 #: Receivers reject other versions outright (status 400) — a version
 #: skew mid-rolling-restart must fall back to local prefill, never
-#: misparse bytes into a cache.
+#: misparse bytes into a cache. OPTIONAL header fields (like the
+#: session-migration ``gen`` block) are additive and do NOT bump the
+#: version: a v1 receiver that predates them never sees the endpoint
+#: that sends them, and JSON headers ignore unknown keys by nature.
 WIRE_VERSION = 1
 
 _PREAMBLE = struct.Struct("<4sHI")  # magic, version, header length
@@ -116,7 +119,8 @@ def blocks_to_slab(leaves: list[np.ndarray]) -> list[np.ndarray]:
 
 
 def encode_segment(*, config_hash: str, tokens, leaves, logits,
-                   layout: str = "slab", block_size: int = 0) -> bytes:
+                   layout: str = "slab", block_size: int = 0,
+                   gen: dict | None = None) -> bytes:
     """Frame one prefix segment for the wire.
 
     ``leaves`` — the segment's cache arrays: batch-1 slab form
@@ -127,6 +131,13 @@ def encode_segment(*, config_hash: str, tokens, leaves, logits,
     Arrays are framed as raw bytes in C order; dtype and shape ride
     the header, so the round-trip is exact for every dtype the engine
     pools (bf16, f32, int8 + f32 scale planes alike).
+
+    ``gen`` — optional LIVE-SESSION state for migration frames: a
+    JSON-able dict carrying the generating request's identity and
+    mid-generation position (prompt length, tokens emitted so far,
+    remaining budget, sampling-key words). Plain-segment frames omit
+    it; receivers that don't understand it never see it (additive
+    header field, see :data:`WIRE_VERSION`).
     """
     if layout not in ("slab", "paged"):
         raise WireError(f"unknown layout {layout!r}")
@@ -145,6 +156,8 @@ def encode_segment(*, config_hash: str, tokens, leaves, logits,
         ],
         "logits": {"dtype": lg.dtype.name, "shape": list(lg.shape)},
     }
+    if gen is not None:
+        header["gen"] = dict(gen)
     hjson = json.dumps(header, sort_keys=True).encode("utf-8")
     parts = [_PREAMBLE.pack(WIRE_MAGIC, WIRE_VERSION, len(hjson)), hjson]
     parts += [a.tobytes() for a in arrs]
@@ -181,7 +194,9 @@ def decode_segment(data: bytes, *,
 
     Returns ``{"config_hash", "layout", "block_size", "tokens"
     (int32 array), "leaves" (batch-1 SLAB-form arrays — paged frames
-    are reassembled), "logits", "nbytes"}``. Raises :class:`WireError`
+    are reassembled), "logits", "gen" (the optional live-session
+    block, ``None`` for plain segments), "nbytes"}``. Raises
+    :class:`WireError`
     (status 400) on bad magic/version, malformed headers, or payloads
     whose byte count disagrees with the declared specs, and (status
     409) when ``expect_hash`` is given and the frame's config hash
@@ -217,6 +232,9 @@ def decode_segment(data: bytes, *,
         logit_spec = dict(header["logits"])
     except (KeyError, TypeError, ValueError):
         raise WireError("header missing required fields") from None
+    gen = header.get("gen")
+    if gen is not None and not isinstance(gen, dict):
+        raise WireError("gen header field must be an object")
     if layout not in ("slab", "paged"):
         raise WireError(f"unknown layout {layout!r}")
     if expect_hash is not None and config_hash != expect_hash:
@@ -246,5 +264,6 @@ def decode_segment(data: bytes, *,
         "tokens": tokens,
         "leaves": leaves,
         "logits": logits,
+        "gen": gen,
         "nbytes": len(data),
     }

@@ -1704,6 +1704,175 @@ class ServingEngine:
                 n += 1
         return n + self.scheduler.cancel_all()
 
+    # -- live session migration --------------------------------------------
+    #
+    # Instead of preempting in-flight requests at the drain deadline,
+    # the server can EXPORT each active slot as a KVSG frame extended
+    # with generation state (tokens so far, remaining budget, the
+    # slot's sampling-key words) and re-seat it on another replica
+    # mid-generation. Byte parity holds by construction: the exported
+    # slab covers rows [0, prompt+generated) — exactly the state a
+    # crash-recovery replay of prompt+tokens rebuilds — the pending
+    # logits row is the next token's sampling input, and fold_in(key,
+    # position) sampling only needs the key words and the position to
+    # continue the identical stream, greedy and sampled alike. The
+    # receiving engine even recovers migrated sessions through its own
+    # crashes: replay uses req.prompt + st.tokens + st.key_data, all
+    # of which the seat installs.
+
+    def export_sessions(self) -> list[dict]:
+        """Snapshot every live slot for migration and free it WITHOUT
+        a terminal status — each request stays RUNNING ("parked"), its
+        waiting handler blocked until :meth:`complete_migrated` /
+        :meth:`fail_migrated` settles it with the destination's
+        outcome. ENGINE-LOOP THREAD ONLY (touches device state and
+        slot bookkeeping); the server services it between steps. A
+        slot whose snapshot fails is skipped and left live — it falls
+        back to the ordinary preempt/recovery path."""
+        if self._inflight is not None:
+            # sync the pipelined horizon first so tokens-so-far and the
+            # device logits row agree on the export position
+            inflight, self._inflight = self._inflight, None
+            self._process(inflight)
+        now = time.perf_counter()
+        out: list[dict] = []
+        for slot, st in enumerate(self._slots):
+            if st is None or st.req.kind not in ("generate", "kv_session"):
+                continue
+            if st.req.cancelled or st.req.expired(now):
+                continue  # the lifecycle sweep owns these
+            t0 = time.perf_counter()
+            req = st.req
+            try:
+                seq = np.concatenate(
+                    [req.prompt, np.asarray(st.tokens, np.int32)]
+                )
+                if self._paged:
+                    slab = self._paged_seg_fetch()(
+                        self.pool.caches,
+                        jnp.asarray(self.pool.table(slot)),
+                    )
+                else:
+                    slab = self._seg_store()(
+                        self.pool.alloc_region(1), self.pool.caches,
+                        jnp.int32(0), jnp.int32(slot),
+                    )
+                leaves = [
+                    np.asarray(leaf)  # lint: sync-ok migration export copies the live segment to host by design
+                    for leaf in jax.tree.leaves(slab)
+                ]
+                lg = np.asarray(  # lint: sync-ok pending logits row rides the migration frame
+                    self._logit_row()(self._logits, jnp.int32(slot))
+                )
+            except Exception as e:  # noqa: BLE001 — skip slot, keep exporting
+                self.flight.record(
+                    "migrate_export_failed", req_id=req.id, slot=slot,
+                    error=str(e),
+                )
+                continue
+            kd = np.asarray(st.key_data).reshape(-1)
+            out.append({
+                "req": req,
+                "n_streamed": len(st.tokens),
+                "config_hash": self.config_hash,
+                "tokens": seq,
+                "leaves": (slab_to_blocks(leaves, self._block_size)
+                           if self._paged else leaves),
+                "logits": lg,
+                "layout": "paged" if self._paged else "slab",
+                "block_size": self._block_size if self._paged else 0,
+                "gen": {
+                    "n_prompt": int(len(req.prompt)),
+                    "tokens": [int(t) for t in st.tokens],
+                    "max_new": int(req.max_new),
+                    "eos_token": (None if req.eos_token is None
+                                  else int(req.eos_token)),
+                    "adapter": int(st.adapter),
+                    "key_data": [int(x) for x in kd.tolist()],
+                    "req_id": req.id,
+                },
+            })
+            # park the request: free the slot with NO terminal status —
+            # the destination's decode finishes it, complete_migrated
+            # stores the result and wakes the handler
+            self.pool.release(slot)
+            if self.prefix_cache is not None:
+                for seg in st.segs:
+                    self.prefix_cache.unpin(seg)
+            st.segs = []
+            self._slots[slot] = None
+            self._dactive = self._deact_fn(self._dactive, jnp.int32(slot))
+            self.metrics.record_migration_out(
+                len(st.tokens), time.perf_counter() - t0,
+                tenant=req.tenant_id,
+            )
+            self.tracer.instant(
+                slot_track(slot), "migrate_out", req_id=req.id,
+                n_tokens=len(st.tokens),
+            )
+            self.flight.record(
+                "migrate_out", req_id=req.id, slot=slot,
+                n_generated=len(st.tokens),
+                tenant=req.tenant_id or None,
+            )
+            log_event(_log, "session_exported", req_id=req.id, slot=slot,
+                      n_generated=len(st.tokens),
+                      tenant=req.tenant_id or None)
+        return out
+
+    def complete_migrated(self, req: Request, tokens,
+                          n_streamed: int = 0) -> None:
+        """Settle a parked (exported) request with the DESTINATION
+        replica's finished token stream (full sequence: prompt +
+        every generated token). Any HTTP/stop thread may call this —
+        the slot is long freed, so only results/metrics/stream state
+        is touched, all of it lock-guarded or thread-safe."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        new = [int(t) for t in toks[len(req.prompt):]]
+        req.status = RequestStatus.FINISHED
+        req.error = None
+        self._store_result(req, new)
+        self.metrics.record_migration_settled(ok=True,
+                                              tenant=req.tenant_id)
+        self.flight.record(
+            "migrate_settled", req_id=req.id, ok=True,
+            n_generated=len(new),
+        )
+        log_event(_log, "request_retired", req_id=req.id, slot=None,
+                  status=req.status.value, n_tokens=len(new),
+                  error=None, tenant=req.tenant_id or None,
+                  kind="migrated")
+        if req.stream is not None:
+            for t in new[int(n_streamed):]:
+                req.stream.put(t)
+            req.stream.put(None)  # end-of-stream sentinel
+        if req.done is not None:
+            req.done.set()
+
+    def fail_migrated(self, req: Request, error: str,
+                      partial=None) -> None:
+        """Settle a parked request whose migration did NOT land: the
+        soft fallback to the pre-migration drain behavior (preempted →
+        CANCELLED), with whatever tokens were generated before export
+        preserved as the partial result."""
+        req.status = RequestStatus.CANCELLED
+        req.error = error
+        self._store_result(
+            req, [int(t) for t in (partial if partial is not None else ())]
+        )
+        self.metrics.record_migration_settled(ok=False,
+                                              tenant=req.tenant_id)
+        self.flight.record(
+            "migrate_settled", req_id=req.id, ok=False, error=error,
+        )
+        log_event(_log, "request_retired", req_id=req.id, slot=None,
+                  status=req.status.value, n_tokens=0, error=error,
+                  tenant=req.tenant_id or None, kind="migrated")
+        if req.stream is not None:
+            req.stream.put(None)  # end-of-stream sentinel
+        if req.done is not None:
+            req.done.set()
+
     # -- retirement --------------------------------------------------------
 
     def _store_result(self, req: Request, tokens: list[int]) -> None:
@@ -2079,6 +2248,114 @@ class ServingEngine:
         )
         seg.block_ids = ids
         return True
+
+    def _serve_kv_session(self, req, now: float) -> None:
+        """Seat a LIVE migrated session (:class:`KVSessionRequest`) in
+        a fresh slot mid-generation. The wire slab covers rows
+        [0, prompt+generated); seating it with pos0 = that length and
+        budget = remaining is EXACTLY the full-hit insert of a
+        seq-so-far segment — an existing, parity-probed program family
+        — after which the ordinary decode loop continues the stream.
+        The migrated sampling-key words are installed verbatim so
+        fold_in(key, position) draws the same randomness the source
+        would have: byte-identical continuation, greedy AND sampled.
+        Every decline is SOFT (``result["seated"] is False`` → the
+        sender keeps its existing fail path for that session)."""
+        t0 = time.perf_counter()
+        seg_data = req.segment
+        n0 = int(len(req.prompt))
+        g = len(req.gen_tokens)
+        m = n0 + g
+        budget = int(req.max_new) - g
+        kd = np.asarray(
+            () if req.key_data is None else req.key_data,
+            self._slot_keys.dtype,
+        ).reshape(-1)
+        reason = None
+        if seg_data.get("config_hash") != self.config_hash:
+            reason = "model config hash mismatch"
+        elif not self._disagg_ok():
+            reason = "disagg wire parity probe failed on this backend"
+        elif int(len(seg_data["tokens"])) != m:
+            reason = (f"frame covers {len(seg_data['tokens'])} tokens, "
+                      f"session claims prompt {n0} + generated {g}")
+        elif n0 + int(req.max_new) > self.max_total or m > self.pool.tpad:
+            reason = (f"session of {m} tokens / budget {req.max_new} "
+                      f"does not fit (max_total={self.max_total}, "
+                      f"tpad={self.pool.tpad})")
+        elif budget < 1:
+            reason = "session has no remaining budget"
+        elif kd.shape != self._slot_keys.shape[1:]:
+            reason = (f"sampling key has {kd.shape} words, engine "
+                      f"uses {self._slot_keys.shape[1:]}")
+        if reason is None:
+            try:
+                slab = self._wire_slab(seg_data)
+            except WireError as e:
+                reason = str(e)
+        if reason is not None:
+            req.result = {"seated": False, "reason": reason}
+            self.metrics.record_migration_in(
+                g, time.perf_counter() - t0, seated=False,
+                tenant=req.tenant_id,
+            )
+            self.flight.record(
+                "migrate_declined", req_id=req.id, reason=reason,
+            )
+            self._retire_unadmitted(req, RequestStatus.FAILED, reason)
+            return
+        eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
+        slot = self.pool.acquire()
+        try:
+            if self._paged:
+                self._paged_ensure_blocks(slot, m + budget)
+            insert = self._paged_insert() if self._paged else self._insert()
+            self._set_state(insert(
+                *self._state(), slab, jnp.asarray(seg_data["logits"]),
+                jnp.int32(slot), jnp.int32(m), jnp.int32(budget),
+                jnp.int32(eos_tok),
+            ))
+        except BaseException:
+            # EngineCrash (or anything unexpected): the popped request
+            # must not be dropped — requeue it before the supervisor
+            # rebuilds state, exactly like an unseated admission plan.
+            self.pool.release(slot)
+            self.scheduler.requeue(req)
+            raise
+        # NO key split here: the slot continues the SOURCE's stream, so
+        # the migrated key words are installed verbatim and this
+        # engine's own key chain is untouched (its replay determinism
+        # for locally admitted requests is unaffected).
+        self._slot_keys[slot] = kd
+        self._slot_adapters[slot] = req.adapter
+        st = _SlotState(req, self.pool.generation(slot), kd, req.adapter)
+        st.tokens = list(req.gen_tokens)
+        st.t_first_token = now if g else None
+        self._slots[slot] = st
+        req.status = RequestStatus.RUNNING
+        req.result = {"seated": True, "n_tokens": m}
+        self.metrics.record_migration_in(
+            g, time.perf_counter() - t0, seated=True,
+            tenant=req.tenant_id,
+        )
+        tctx = {}
+        if self.tracer.enabled and req.trace_id:
+            tctx = {"trace_id": req.trace_id, "span_id": new_span_id()}
+            if req.parent_span_id:
+                tctx["parent_span_id"] = req.parent_span_id
+        self.tracer.span(
+            slot_track(slot), "migrate_in", t0,
+            time.perf_counter() - t0, req_id=req.id,
+            n_tokens=m, **tctx,
+        )
+        self.flight.record(
+            "migrate_seated", req_id=req.id, slot=slot,
+            n_generated=g, budget=budget,
+            tenant=req.tenant_id or None,
+        )
+        log_event(_log, "session_seated", req_id=req.id, slot=slot,
+                  prompt_len=n0, n_generated=g, budget=budget,
+                  tenant=req.tenant_id or None)
 
     def _slot_of(self, req_id: str | None) -> int | None:
         if req_id is None:
@@ -3203,6 +3480,14 @@ class ServingEngine:
                     continue
                 if req.kind == "kv_export":
                     self._serve_kv_export(req, now)  # lint: sync-ok export materializes the wire frame bytes
+                    continue
+                if req.kind == "kv_session":
+                    # seats synchronously (pool/block state updates
+                    # before the next admissible() check); count the
+                    # held slot against its tenant's cap like a plan
+                    self._serve_kv_session(req, now)  # lint: sync-ok migrated session must seat before decode admits
+                    if req.status is RequestStatus.RUNNING:
+                        used[req.tenant_id] = used.get(req.tenant_id, 0) + 1
                     continue
                 plans.append(_AdmitPlan(req, self.pool.acquire()))
                 used[req.tenant_id] = used.get(req.tenant_id, 0) + 1

@@ -210,6 +210,17 @@ class ServingMetrics:
         self.kv_export_latency = Reservoir(reservoir_cap)
         self.kv_ingest_latency = Reservoir(reservoir_cap)
         self.transfer_latency = Reservoir(reservoir_cap)
+        # live session migration (drain-time export/seat/settle):
+        # exports = slots parked here and shipped out, seats = migrated
+        # sessions offered to this engine (seated or declined),
+        # settlements = parked requests resolved by the destination's
+        # outcome (ok) or by the fail fallback (failed)
+        self.n_migrations_out = 0
+        self.n_migrations_seated = 0
+        self.n_migrations_declined = 0
+        self.n_migrations_settled_ok = 0
+        self.n_migrations_settled_failed = 0
+        self.migration_seat_latency = Reservoir(reservoir_cap)
         self._reservoir_cap = reservoir_cap
         # per-tenant state, created lazily on the first event carrying a
         # non-empty tenant id. HTTP handler threads record rejections
@@ -345,6 +356,26 @@ class ServingMetrics:
         self._h_transfer = reg.histogram(
             "serve_transfer_seconds",
             "One KV segment push: POST /v1/kv_segment round trip.",
+        )
+        self._c_migrations_out = reg.counter(
+            "serve_migrations_out_total",
+            "Live sessions exported (parked) at drain for re-seating "
+            "on another replica.",
+        )
+        self._c_migrations_in = reg.counter(
+            "serve_migrations_in_total",
+            "Migrated live sessions offered to this engine, by result "
+            "(seated|declined).", ("result",),
+        )
+        self._c_migrations_settled = reg.counter(
+            "serve_migrations_settled_total",
+            "Parked requests resolved, by result (ok = destination "
+            "finished the stream, failed = fallback preemption).",
+            ("result",),
+        )
+        self._h_migration_seat = reg.histogram(
+            "serve_migration_seat_seconds",
+            "One migrated session seat: validate + device insert.",
         )
         self._c_prog_seconds = reg.counter(
             "serve_program_seconds_total",
@@ -579,6 +610,42 @@ class ServingMetrics:
         else:
             self.n_transfer_failures += 1
 
+    def record_migration_out(self, n_generated: int, seconds: float,
+                             tenant: str = "") -> None:
+        """One live slot exported (parked) for migration at drain."""
+        self.n_migrations_out += 1
+        self._c_migrations_out.inc()
+        self._emit("migration_export_seconds", seconds)
+
+    def record_migration_in(self, n_generated: int, seconds: float, *,
+                            seated: bool, tenant: str = "") -> None:
+        """One migrated session offered to this engine. A decline is
+        soft — the source keeps its existing fail path."""
+        if seated:
+            self.n_migrations_seated += 1
+        else:
+            self.n_migrations_declined += 1
+        self.migration_seat_latency.add(float(seconds))
+        self._c_migrations_in.inc(
+            result="seated" if seated else "declined"
+        )
+        self._h_migration_seat.observe(seconds)
+        self._emit("migration_seat_seconds", seconds)
+        if tenant and seated:
+            self._c_tenant_requests.inc(tenant=tenant,
+                                        outcome="migrated_in")
+
+    def record_migration_settled(self, *, ok: bool,
+                                 tenant: str = "") -> None:
+        """One parked request resolved: the destination finished its
+        stream (ok) or migration failed and the request fell back to
+        the preemption path."""
+        if ok:
+            self.n_migrations_settled_ok += 1
+        else:
+            self.n_migrations_settled_failed += 1
+        self._c_migrations_settled.inc(result="ok" if ok else "failed")
+
     def record_prefix_lookup(self, result: str, saved_tokens: int) -> None:
         """One admission-time prefix-cache lookup. ``result`` is
         ``hit_full``/``hit_partial``/``miss``; ``saved_tokens`` is how
@@ -748,6 +815,20 @@ class ServingMetrics:
                         self.transfer_bytes / self.transfer_seconds
                     )
             out["disagg"] = d
+        if (self.n_migrations_out or self.n_migrations_seated
+                or self.n_migrations_declined):
+            d = {
+                "migrations_out": self.n_migrations_out,
+                "migrations_seated": self.n_migrations_seated,
+                "migrations_declined": self.n_migrations_declined,
+                "migrations_settled_ok": self.n_migrations_settled_ok,
+                "migrations_settled_failed":
+                    self.n_migrations_settled_failed,
+            }
+            if self.migration_seat_latency:
+                d["seat_p50_s"] = _pct(self.migration_seat_latency, 50)
+                d["seat_p99_s"] = _pct(self.migration_seat_latency, 99)
+            out["migration"] = d
         with self._tlock:
             if self.n_rejections:
                 out["rejections"] = dict(self.n_rejections)

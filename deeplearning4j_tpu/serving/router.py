@@ -39,6 +39,15 @@ retries onto survivors — so the merged Perfetto view (``trace-merge``)
 shows the failed attempt and the retry as sibling spans under one
 trace, each linked by a flow arrow to the replica's admission span.
 
+RESILIENCE (PR 17): per-replica circuit breakers
+(:class:`~deeplearning4j_tpu.serving.rpc.CircuitBreaker`,
+closed/open/half-open with exponential probe backoff) gate dispatch —
+a health-poll success alone never closes an open breaker, only a
+successful forwarded request does — and every attempt honors the
+caller's ``X-Deadline-Ms`` budget (socket timeouts derived from it,
+shrunken budget re-forwarded downstream). Generate forwards are never
+hedged: decoding is not idempotent.
+
 Endpoints: ``POST /v1/generate`` (routed passthrough; replica status
 codes and bodies are forwarded verbatim, plus ``X-Served-By``),
 ``GET /healthz`` (200 while >= 1 replica is healthy), ``GET /replicas``
@@ -69,6 +78,13 @@ from deeplearning4j_tpu.obs.trace import (
     new_span_id,
     new_trace_id,
     parse_traceparent,
+)
+from deeplearning4j_tpu.serving.rpc import (
+    CLOSED,
+    DEADLINE_HEADER,
+    HALF_OPEN,
+    CircuitBreaker,
+    Deadline,
 )
 from deeplearning4j_tpu.utils.httpjson import (
     QuietHandler,
@@ -144,7 +160,7 @@ class _Replica:
     __slots__ = ("host", "port", "healthy", "in_flight", "routed",
                  "affinity_routed", "retried_away", "shadow",
                  "last_health", "lock", "draining", "incompatible",
-                 "config_hash")
+                 "config_hash", "breaker")
 
     def __init__(self, host: str, port: int):
         self.host = host
@@ -170,6 +186,11 @@ class _Replica:
         # serves a different checkpoint now, not this fleet's model
         self.config_hash: str | None = None
         self.incompatible = False  # guarded-by: _route_lock
+        # per-replica circuit breaker; dispatch gates on it instead of
+        # the binary healthy flag alone (the flag stays as the
+        # liveness VIEW). The router replaces this with one wired to
+        # its transition hooks.
+        self.breaker = CircuitBreaker()
 
     @property
     def name(self) -> str:
@@ -187,6 +208,7 @@ class _Replica:
             "retried_away": self.retried_away,
             "shadow_nodes": len(self.shadow),
             "last_health": self.last_health,
+            "breaker": self.breaker.snapshot(),
         }
 
 
@@ -284,9 +306,21 @@ class ReplicaRouter:
             "for generate this is the replica's full service time — "
             "the router's honest first-byte bound.",
             labelnames=("replica",))
+        self._m_breaker = reg.gauge(
+            "router_breaker_state",
+            "Circuit breaker per replica: 0 closed, 0.5 half-open, "
+            "1 open.",
+            labelnames=("replica",))
+        self._m_breaker_transitions = reg.counter(
+            "router_breaker_transitions_total",
+            "Breaker state changes, per replica and new state.",
+            labelnames=("replica", "state"))
         for r in self.replicas:
             self._m_healthy.set(1.0, replica=r.name)
             self._m_in_flight.set(0.0, replica=r.name)
+            self._m_breaker.set(0.0, replica=r.name)
+            r.breaker = CircuitBreaker(
+                on_transition=self._breaker_hook(r.name))
 
         router = self
 
@@ -319,7 +353,8 @@ class ReplicaRouter:
                     send_json(self, 400, {"error": "malformed JSON"})
                     return
                 code, payload, served_by = router.route(
-                    body, traceparent=self.headers.get("traceparent"))
+                    body, traceparent=self.headers.get("traceparent"),
+                    deadline_ms=self.headers.get(DEADLINE_HEADER))
                 # forward the replica's JSON verbatim, tagging which
                 # backend actually served it (observability + tests)
                 self.send_response(code)
@@ -361,11 +396,19 @@ class ReplicaRouter:
         ``(replica, via_affinity)``. Raises ``_ReplicaDown`` when no
         healthy candidate remains."""
         with self._route_lock:
-            candidates = [
+            avail = [
                 r for r in self.replicas
                 if r.healthy and not r.draining and not r.incompatible
                 and r.name not in exclude
             ]
+            # breaker-gated: closed breakers are the normal pool; when
+            # it is empty, ONE due probe through an open breaker is
+            # admitted (half-open) so a recovered replica proves
+            # itself on real traffic. allow() consumes the probe, so
+            # only ask when no closed-breaker replica remains.
+            candidates = [r for r in avail if r.breaker.state == CLOSED]
+            if not candidates:
+                candidates = [r for r in avail if r.breaker.allow()]
             if not candidates:
                 raise _ReplicaDown("no healthy replica")
             best, best_match = None, -1
@@ -395,13 +438,16 @@ class ReplicaRouter:
                 float(chosen.in_flight), replica=chosen.name)
             return chosen, via_affinity
 
-    def _forward(self, replica: _Replica, raw: bytes,
-                 headers: dict) -> tuple[int, bytes]:
+    def _forward(self, replica: _Replica, raw: bytes, headers: dict,
+                 dl: Deadline | None = None) -> tuple[int, bytes]:
         """POST the raw body to the replica's generate endpoint.
         Transport failures and 503 (draining / dead engine) raise
-        ``_ReplicaDown`` so the caller retries elsewhere."""
+        ``_ReplicaDown`` so the caller retries elsewhere. The socket
+        timeout derives from the request's deadline budget."""
         conn = http.client.HTTPConnection(
-            replica.host, replica.port, timeout=self.request_timeout_s)
+            replica.host, replica.port,
+            timeout=(dl.timeout(self.request_timeout_s)
+                     if dl is not None else self.request_timeout_s))
         try:
             t0 = time.perf_counter()
             conn.request("POST", "/v1/generate", body=raw,
@@ -413,6 +459,7 @@ class ReplicaRouter:
             payload = resp.read()
             if resp.status == 503:
                 raise _ReplicaDown(f"{replica.name} answered 503")
+            replica.breaker.record_success()
             self._h_ttft.observe(ttft, replica=replica.name)
             return resp.status, payload
         except (OSError, http.client.HTTPException) as e:
@@ -421,12 +468,19 @@ class ReplicaRouter:
             conn.close()
 
     def route(self, body: dict,
-              traceparent: str | None = None
+              traceparent: str | None = None,
+              deadline_ms: str | None = None
               ) -> tuple[int, bytes, str | None]:
         """Route one generate request; returns
         ``(status, payload_bytes, replica_name | None)``. Retries on
         the remaining healthy replicas after transport-level failures
-        (the failed replica never accepted the request).
+        (the failed replica never accepted the request). Generate
+        forwards are never HEDGED — decoding is not idempotent; only
+        retry-after-failure is safe.
+
+        The caller's ``X-Deadline-Ms`` budget bounds every attempt's
+        socket timeout and is re-forwarded (shrunken) downstream; an
+        exhausted budget answers a clean 504 instead of piling retries.
 
         Trace context: the caller's ``traceparent`` is adopted (or a
         trace started), and every forward attempt — retries included —
@@ -438,6 +492,8 @@ class ReplicaRouter:
         self._m_requests.inc()
         ctx = parse_traceparent(traceparent)
         trace_id, parent_span = ctx if ctx else (new_trace_id(), "")
+        dl = Deadline.from_header(deadline_ms,
+                                  default_s=self.request_timeout_s)
         tokens = self._prompt_tokens(body)
         raw = json.dumps(body).encode()
         exclude: set[str] = set()
@@ -445,6 +501,10 @@ class ReplicaRouter:
         attempt = 0
         try:
             while True:
+                if dl.expired():
+                    return 504, json.dumps(
+                        {"error": "deadline exhausted",
+                         "attempts": attempt}).encode(), None
                 try:
                     replica, via_affinity = self._pick(tokens, exclude)
                 except _ReplicaDown:
@@ -462,6 +522,7 @@ class ReplicaRouter:
                     "Content-Type": "application/json",
                     "traceparent": format_traceparent(trace_id, span_id),
                     "X-Served-By": replica.name,
+                    DEADLINE_HEADER: dl.header_value(),
                 }
                 if self.flight.enabled:
                     self.flight.record(
@@ -471,7 +532,7 @@ class ReplicaRouter:
                 t_try = time.perf_counter()
                 try:
                     status, payload = self._forward(
-                        replica, raw, headers)
+                        replica, raw, headers, dl)
                     self._trace_dispatch(
                         trace_id, span_id, parent_span, replica.name,
                         attempt, t_try, status=status)
@@ -518,7 +579,24 @@ class ReplicaRouter:
     # health                                                         #
     # ------------------------------------------------------------- #
 
+    def _breaker_hook(self, name: str):
+        """Transition listener for one replica's breaker: gauge,
+        counter, and flight event per state change. Fires inside the
+        breaker's own lock, so it must stay cheap and must not take
+        ``_route_lock``."""
+        def hook(old: str, new: str) -> None:
+            self._m_breaker.set(
+                {CLOSED: 0.0, HALF_OPEN: 0.5}.get(new, 1.0),
+                replica=name)
+            self._m_breaker_transitions.inc(replica=name, state=new)
+            self.flight.record("breaker", replica=name,
+                               old=old, new=new)
+            log_event(_log, "router_breaker", replica=name,
+                      old=old, new=new)
+        return hook
+
     def _mark_unhealthy(self, replica: _Replica, why: str) -> None:
+        replica.breaker.record_failure()
         with self._route_lock:
             note_access(f"router.{replica.name}.healthy", write=True)
             flipped = replica.healthy

@@ -101,6 +101,9 @@ import queue
 import signal
 import threading
 import time
+
+import numpy as np
+
 from http.server import ThreadingHTTPServer
 from pathlib import Path
 from urllib.parse import parse_qs, urlparse
@@ -118,12 +121,19 @@ from deeplearning4j_tpu.serving.disagg import (
     encode_segment,
 )
 from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.rpc import (
+    DEADLINE_HEADER,
+    IDEMPOTENCY_HEADER,
+    Deadline,
+    IdempotencyRegistry,
+)
 from deeplearning4j_tpu.serving.scheduler import (
     AdmissionError,
     Backpressure,
     EmbeddingRequest,
     KVExportRequest,
     KVIngestRequest,
+    KVSessionRequest,
     Request,
     RequestStatus,
 )
@@ -158,11 +168,25 @@ class ServingServer:
                  port: int = 0, request_timeout_s: float = 300.0,
                  max_restarts: int = 5, hang_threshold_s: float = 120.0,
                  metrics_port: int | None = None,
-                 flight_dir: str | None = None):
+                 flight_dir: str | None = None,
+                 migrate_targets: tuple[str, ...] = ()):
         self.engine = engine
         self.request_timeout_s = request_timeout_s
         self.max_restarts = max_restarts
         self.hang_threshold_s = hang_threshold_s
+        # default destinations for live session migration: tried in
+        # order by ``stop()`` at the drain deadline (and by POST
+        # /migrate with no body) before falling back to preemption
+        self.migrate_targets = tuple(migrate_targets)
+        # receiver-side dedup for hedged/retried seat+ingest legs: a
+        # duplicate X-Idempotency-Key is declined with 409, never
+        # seated twice
+        self._idem = IdempotencyRegistry()
+        # one-slot mailbox for the engine loop: the migrate path posts
+        # {"evt": Event} here and the loop (the only thread allowed to
+        # touch device/slot state) fills in "sessions" between steps
+        self._migrate_box: dict | None = None
+        self._migrate_lock = threading.Lock()
         # postmortem bundle directory (crash / watchdog / SIGTERM
         # dumps); DL4J_TPU_FLIGHT_DIR supplies a default for wiring
         # sites that don't thread the kwarg (the CI chaos lane sets it)
@@ -213,8 +237,14 @@ class ServingServer:
                     # must be able to undrain a replica it drained
                     server._handle_drain(self, path == "/drain")
                     return
+                if path == "/migrate":
+                    # also reachable while paused: the controller drains
+                    # a replica FIRST, then asks it to migrate leftovers
+                    server._handle_migrate(self)
+                    return
                 if path not in ("/v1/generate", "/v1/embeddings",
-                                "/v1/kv_segment", "/v1/prefill"):
+                                "/v1/kv_segment", "/v1/prefill",
+                                "/v1/kv_session"):
                     send_json(self, 404, {"error": "not found"})
                     return
                 if (server._draining.is_set() or server._paused.is_set()
@@ -234,6 +264,10 @@ class ServingServer:
                 if path == "/v1/kv_segment":
                     # binary wire frame, not JSON
                     server._handle_kv_segment(self, tenant)
+                    return
+                if path == "/v1/kv_session":
+                    # binary wire frame with live-session state
+                    server._handle_kv_session(self, tenant)
                     return
                 body = read_json_body(self)
                 if body is None:
@@ -444,6 +478,18 @@ class ServingServer:
         else:
             req.trace_id = new_trace_id()
 
+    def _deadline(self, handler) -> Deadline:
+        """Per-request deadline budget: honor the caller's
+        ``X-Deadline-Ms`` header (router/controller shrink it on every
+        hop) and fall back to the server's own request timeout. Every
+        blocking wait and outbound leg below derives its timeout from
+        this budget, so a request never outlives what the first hop
+        promised the client."""
+        return Deadline.from_header(
+            handler.headers.get(DEADLINE_HEADER),
+            default_s=self.request_timeout_s,
+        )
+
     def _access_log(self, handler, req, http: int, status: str,
                     **fields) -> None:
         """The one structured access-log line per request: resolved
@@ -466,6 +512,12 @@ class ServingServer:
             send_json(handler, 400, {"error": str(e)})
             return
         self._resolve_trace(handler, req)
+        dl = self._deadline(handler)
+        if req.deadline_s is None and handler.headers.get(DEADLINE_HEADER):
+            # mirror the wire budget into engine-side expiry so a
+            # queued request whose budget lapsed retires EXPIRED
+            # instead of decoding for a caller that already gave up
+            req.deadline_s = dl.remaining_s()
         try:
             self.engine.submit(req)
         except Backpressure as e:
@@ -477,9 +529,12 @@ class ServingServer:
             send_json(handler, 400, {"error": str(e)})
             return
         if req.stream is not None:
-            self._stream_generate(handler, req)
+            self._stream_generate(
+                handler, req,
+                wait_s=dl.timeout(self.request_timeout_s, floor=0.0),
+            )
             return
-        if not req.done.wait(self.request_timeout_s):
+        if not req.done.wait(dl.timeout(self.request_timeout_s, floor=0.0)):
             # cancel in the engine so the slot stops decoding
             # for a client that is about to get a timeout
             req.cancel()
@@ -527,7 +582,8 @@ class ServingServer:
                             + b"\n\n")
         handler.wfile.flush()
 
-    def _stream_generate(self, handler, req: Request) -> None:
+    def _stream_generate(self, handler, req: Request,
+                         wait_s: float | None = None) -> None:
         """SSE relay: one frame per generated token as each horizon's
         readback lands on ``req.stream``, then a final frame with the
         terminal status. The engine sets the terminal status BEFORE
@@ -540,7 +596,9 @@ class ServingServer:
         handler.send_header("Cache-Control", "no-cache")
         handler.send_header("Connection", "close")
         handler.end_headers()
-        deadline = time.monotonic() + self.request_timeout_s
+        deadline = time.monotonic() + (
+            self.request_timeout_s if wait_s is None else wait_s
+        )
         byte_vocab = self._byte_vocab()
         n = 0
         try:
@@ -612,6 +670,7 @@ class ServingServer:
             done=threading.Event(),
         )
         self._resolve_trace(handler, req)
+        dl = self._deadline(handler)
         try:
             self.engine.submit(req)
         except Backpressure as e:
@@ -624,7 +683,7 @@ class ServingServer:
                              kind="embedding")
             send_json(handler, 400, {"error": str(e)})
             return
-        if not req.done.wait(self.request_timeout_s):
+        if not req.done.wait(dl.timeout(self.request_timeout_s, floor=0.0)):
             req.cancel()
             log_event(_log, "request_completed", req_id=req.id,
                       http=504, status="timeout", kind="embedding",
@@ -686,7 +745,17 @@ class ServingServer:
         through the engine's admission loop. 400/409 come straight from
         ``WireError.status``; otherwise 200 with ``{"stored": bool,
         "reason"}`` — a decline (cache full, parity probe failed) is
-        not an error, the sender just forfeits the transfer win."""
+        not an error, the sender just forfeits the transfer win. A
+        repeated ``X-Idempotency-Key`` (a hedged retransmit of a frame
+        already being seated) is declined with 409 so the frame is
+        never ingested twice."""
+        dl = self._deadline(handler)
+        idem = handler.headers.get(IDEMPOTENCY_HEADER, "")
+        if not self._idem.first_seen(idem):
+            log_event(_log, "kv_segment_duplicate", idem_key=idem)
+            send_json(handler, 409, {"error": "duplicate frame",
+                                     "duplicate": True, "stored": False})
+            return
         try:
             length = int(handler.headers.get("Content-Length", "0"))
             data = handler.rfile.read(length)
@@ -719,7 +788,7 @@ class ServingServer:
                              kind="kv_ingest")
             send_json(handler, 400, {"error": str(e)})
             return
-        if not req.done.wait(self.request_timeout_s):
+        if not req.done.wait(dl.timeout(self.request_timeout_s, floor=0.0)):
             req.cancel()
             self._access_log(handler, req, 504, "timeout",
                              kind="kv_ingest")
@@ -738,6 +807,115 @@ class ServingServer:
         self._access_log(handler, req, 200, "finished", kind="kv_ingest",
                          stored=bool(req.result.get("stored")))
         send_json(handler, 200, {"id": req.id, **req.result})
+
+    def _handle_kv_session(self, handler, tenant) -> None:
+        """``POST /v1/kv_session``: seat one LIVE migrated session — a
+        KV-segment frame whose ``gen`` header block carries the source
+        slot's generation state (tokens so far, sampling key, budget) —
+        and decode it to completion here. 200 answers with the FULL
+        final token sequence; any seating decline is a soft 409 (the
+        sender keeps the session and falls back to its preempt path);
+        a repeated idempotency key (a hedged retransmit) is 409 with
+        ``"duplicate": true``. Never 200-with-wrong-bytes: the engine
+        declines anything it cannot continue byte-identically."""
+        dl = self._deadline(handler)
+        idem = handler.headers.get(IDEMPOTENCY_HEADER, "")
+        if not self._idem.first_seen(idem):
+            log_event(_log, "kv_session_duplicate", idem_key=idem)
+            send_json(handler, 409, {"error": "duplicate session frame",
+                                     "duplicate": True})
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", "0"))
+            data = handler.rfile.read(length)
+        except (ValueError, OSError):
+            send_json(handler, 400, {"error": "unreadable body"})
+            return
+        try:
+            seg = decode_segment(data, expect_hash=self.engine.config_hash)
+        except WireError as e:
+            log_event(_log, "kv_session_rejected", error=str(e),
+                      http=e.status, nbytes=len(data))
+            send_json(handler, e.status, {"error": str(e)})
+            return
+        gen = seg.get("gen")
+        if not isinstance(gen, dict):
+            send_json(handler, 400, {
+                "error": "frame carries no session state ('gen' header)",
+            })
+            return
+        try:
+            n_prompt = int(gen["n_prompt"])
+            req = KVSessionRequest(
+                prompt=[int(t) for t in seg["tokens"][:n_prompt]],
+                max_new=int(gen["max_new"]),
+                eos_token=(None if gen.get("eos_token") is None
+                           else int(gen["eos_token"])),
+                adapter=int(gen.get("adapter", 0)),
+                priority=tenant.priority if tenant is not None else 1,
+                tenant_id=tenant.tenant_id if tenant is not None else "",
+                segment=seg,
+                gen_tokens=tuple(int(t) for t in gen.get("tokens", ())),
+                key_data=np.asarray(gen.get("key_data", ()), np.uint32),
+                done=threading.Event(),
+            )
+        except (AdmissionError, KeyError, TypeError, ValueError) as e:
+            send_json(handler, 400, {
+                "error": f"bad session state: {type(e).__name__}: {e}",
+            })
+            return
+        self._resolve_trace(handler, req)
+        try:
+            self.engine.submit(req)
+        except Backpressure as e:
+            self._access_log(handler, req, 429, "backpressure",
+                             kind="kv_session")
+            send_json(handler, 429, {"error": str(e)})
+            return
+        except AdmissionError as e:
+            self._access_log(handler, req, 400, "admission_error",
+                             kind="kv_session")
+            send_json(handler, 400, {"error": str(e)})
+            return
+        if not req.done.wait(dl.timeout(self.request_timeout_s, floor=0.0)):
+            req.cancel()
+            self._access_log(handler, req, 504, "timeout",
+                             kind="kv_session")
+            send_json(handler, 504, {"error": "session seat timed out"})
+            return
+        if (req.status is RequestStatus.FAILED
+                and isinstance(req.result, dict)
+                and not req.result.get("seated", True)):
+            # soft decline: the engine could not guarantee byte-exact
+            # continuation (hash/shape/parity mismatch); 409 tells the
+            # sender to keep the session on its own fallback path
+            self._access_log(handler, req, 409, "declined",
+                             kind="kv_session",
+                             reason=req.result.get("reason"))
+            send_json(handler, 409, {
+                "id": req.id, "seated": False,
+                "reason": req.result.get("reason"),
+                "error": req.error or "session declined",
+            })
+            return
+        if req.status is not RequestStatus.FINISHED:
+            code = _STATUS_HTTP.get(req.status, 500)
+            self.engine.pop_result(req.id)
+            self._access_log(handler, req, code, req.status.value,
+                             kind="kv_session")
+            send_json(handler, code, {
+                "id": req.id,
+                "status": req.status.value,
+                "error": req.error or req.status.value,
+            })
+            return
+        toks = self.engine.pop_result(req.id).tolist()
+        self._access_log(handler, req, 200, "finished", kind="kv_session",
+                         n_tokens=len(toks) - len(req.prompt))
+        send_json(handler, 200, {
+            "id": req.id, "status": "finished", "tokens": toks,
+            "n_generated": len(toks) - len(req.prompt),
+        })
 
     def _handle_prefill(self, handler, body: dict, tenant) -> None:
         """``POST /v1/prefill``: prefill-only — compute the prompt's KV
@@ -775,6 +953,7 @@ class ServingServer:
             done=threading.Event(),
         )
         self._resolve_trace(handler, req)
+        dl = self._deadline(handler)
         try:
             self.engine.submit(req)
         except Backpressure as e:
@@ -787,7 +966,7 @@ class ServingServer:
                              kind="kv_export")
             send_json(handler, 400, {"error": str(e)})
             return
-        if not req.done.wait(self.request_timeout_s):
+        if not req.done.wait(dl.timeout(self.request_timeout_s, floor=0.0)):
             req.cancel()
             self._access_log(handler, req, 504, "timeout",
                              kind="kv_export")
@@ -814,7 +993,8 @@ class ServingServer:
         push_to = body.get("push_to")
         if push_to:
             pushed, info = self._push_segment(
-                str(push_to), frame, req, res.get("span_id")
+                str(push_to), frame, req, res.get("span_id"),
+                idem_key=str(body.get("idem_key") or ""), deadline=dl,
             )
             out["pushed"] = pushed
             if info:
@@ -824,7 +1004,8 @@ class ServingServer:
         send_json(handler, 200, out)
 
     def _push_segment(self, target: str, frame: bytes, req,
-                      parent_span: str | None) -> tuple[bool, dict]:
+                      parent_span: str | None, *, idem_key: str = "",
+                      deadline: Deadline | None = None) -> tuple[bool, dict]:
         """POST the frame to ``target``'s ``/v1/kv_segment``; returns
         ``(ok, ingest response)``. Emits a real "transfer" span — the
         flow anchor chaining prefill -> transfer -> decode ingest in
@@ -839,10 +1020,22 @@ class ServingServer:
         ok = False
         err = None
         try:
+            # the push leg's socket timeout comes from the request's
+            # remaining deadline budget, not a fixed constant, so a
+            # shrunken budget can't be blown waiting on one transfer
             conn = http.client.HTTPConnection(
-                host or "127.0.0.1", int(port), timeout=30
+                host or "127.0.0.1", int(port),
+                timeout=(deadline.timeout(self.request_timeout_s)
+                         if deadline is not None
+                         else min(30.0, self.request_timeout_s)),
             )
             headers = {"Content-Type": "application/octet-stream"}
+            if idem_key:
+                # hedged transfers share this key; the decode replica
+                # seats the first copy and 409s the loser
+                headers[IDEMPOTENCY_HEADER] = idem_key
+            if deadline is not None:
+                headers[DEADLINE_HEADER] = deadline.header_value()
             if req.trace_id:
                 headers["traceparent"] = format_traceparent(
                     req.trace_id, span_id
@@ -879,6 +1072,173 @@ class ServingServer:
             info = dict(info)
             info["error"] = err
         return ok, info
+
+    # -- live session migration ----------------------------------------
+
+    def _handle_migrate(self, handler) -> None:
+        """``POST /migrate``: export every live generation session and
+        re-seat each on one of the target replicas (body ``{"targets":
+        ["host:port", ...]}``, falling back to the configured
+        ``migrate_targets``), completing the original client requests
+        with the destination's bytes. Sessions that cannot be moved
+        stay on the ordinary drain/preempt path — migration is
+        strictly best-effort on top of it, never a new failure mode."""
+        body = read_json_body(handler)
+        if body is None:
+            body = {}
+        targets = body.get("targets") or list(self.migrate_targets)
+        if not isinstance(targets, (list, tuple)):
+            send_json(handler, 400, {"error": "'targets' must be a list"})
+            return
+        res = self._migrate_sessions(
+            [str(t) for t in targets], self._deadline(handler)
+        )
+        send_json(handler, 200 if "error" not in res else 503, res)
+
+    def _migrate_sessions(self, targets: list[str],
+                          deadline: Deadline | None = None) -> dict:
+        """Export every live generation session from the engine loop
+        (see ``ServingEngine.export_sessions``) and push each to the
+        first target that seats AND completes it. Completed sessions
+        answer their original blocked clients with the destination's
+        bytes; push failures retire the session through the ordinary
+        cancelled-drain path with its partial tokens. Serialized under
+        a lock: concurrent ``/migrate`` posts and the ``stop()`` path
+        share one export mailbox."""
+        targets = [t for t in targets if t]
+        out = {"targets": list(targets), "exported": 0,
+               "migrated": 0, "failed": 0}
+        if not targets:
+            out["error"] = "no migration targets"
+            return out
+        with self._migrate_lock:
+            if (not self._engine_thread.is_alive()
+                    or self._engine_dead.is_set()):
+                out["error"] = "engine not running"
+                return out
+            evt = threading.Event()
+            box: dict = {"evt": evt}
+            self._migrate_box = box
+            wait_s = (deadline.timeout(30.0) if deadline is not None
+                      else 30.0)
+            t_end = time.monotonic() + wait_s
+            # the loop exits once drained-and-idle, so poll aliveness
+            # rather than block the full window against a gone thread
+            while not evt.is_set() and time.monotonic() < t_end:
+                if (not self._engine_thread.is_alive()
+                        or self._engine_dead.is_set()):
+                    break
+                evt.wait(0.05)
+            if not evt.is_set():
+                self._migrate_box = None
+                out["error"] = "engine loop unavailable for export"
+                return out
+            if "error" in box:
+                out["error"] = box["error"]
+                return out
+            sessions = box.get("sessions") or []
+            out["exported"] = len(sessions)
+            for sess in sessions:
+                ok, info = self._push_session(sess, targets, deadline)
+                if ok:
+                    self.engine.complete_migrated(
+                        sess["req"], info["tokens"],
+                        n_streamed=sess["n_streamed"],
+                    )
+                    out["migrated"] += 1
+                else:
+                    self.engine.fail_migrated(
+                        sess["req"],
+                        info.get("error") or "migration push failed",
+                        partial=sess["gen"]["tokens"],
+                    )
+                    out["failed"] += 1
+        log_event(_log, "migrate",
+                  exported=out["exported"], migrated=out["migrated"],
+                  failed=out["failed"], n_targets=len(targets),
+                  error=out.get("error"))
+        return out
+
+    def _push_session(self, sess: dict, targets: list[str],
+                      deadline: Deadline | None = None,
+                      ) -> tuple[bool, dict]:
+        """POST one exported session frame to each target's
+        ``/v1/kv_session`` until one seats and completes it. The
+        idempotency key is derived from the request id, so a retry
+        racing a slow-but-successful earlier attempt to the same
+        replica is declined (409) instead of double-seated. Returns
+        ``(ok, response)``; a successful response carries the full
+        final token list."""
+        req = sess["req"]
+        frame = encode_segment(
+            config_hash=sess["config_hash"], tokens=sess["tokens"],
+            leaves=sess["leaves"], logits=sess["logits"],
+            layout=sess["layout"], block_size=sess["block_size"],
+            gen=sess["gen"],
+        )
+        last: dict = {}
+        for target in targets:
+            host, _, port = target.rpartition(":")
+            t0 = time.perf_counter()
+            span_id = new_span_id()
+            err = None
+            info: dict = {}
+            status = 0
+            try:
+                conn = http.client.HTTPConnection(
+                    host or "127.0.0.1", int(port),
+                    timeout=(deadline.timeout(self.request_timeout_s)
+                             if deadline is not None
+                             else self.request_timeout_s),
+                )
+                headers = {
+                    "Content-Type": "application/octet-stream",
+                    IDEMPOTENCY_HEADER: "mig-" + req.id,
+                }
+                if deadline is not None:
+                    headers[DEADLINE_HEADER] = deadline.header_value()
+                if req.trace_id:
+                    headers["traceparent"] = format_traceparent(
+                        req.trace_id, span_id
+                    )
+                conn.request("POST", "/v1/kv_session", body=frame,
+                             headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                conn.close()
+                status = resp.status
+                try:
+                    info = json.loads(raw.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    info = {}
+            except (OSError, ValueError) as e:
+                err = repr(e)
+            dt = time.perf_counter() - t0
+            ok = (err is None and status == 200
+                  and info.get("status") == "finished"
+                  and isinstance(info.get("tokens"), list))
+            if err is None and not ok:
+                err = "http %d: %s" % (
+                    status, info.get("reason") or info.get("error"),
+                )
+            if self.engine.tracer.enabled and req.trace_id:
+                self.engine.tracer.span(
+                    "migrate_push", "transfer", t0, dt, target=target,
+                    nbytes=len(frame), ok=ok, trace_id=req.trace_id,
+                    span_id=span_id,
+                )
+            self.engine.flight.record(
+                "migrate_push", req_id=req.id, target=target, ok=ok,
+                http=status or None, error=err,
+            )
+            log_event(_log, "session_migrate_push", req_id=req.id,
+                      target=target, nbytes=len(frame), ok=ok,
+                      seconds=round(dt, 6), error=err)
+            if ok:
+                return True, info
+            last = dict(info)
+            last["error"] = err
+        return False, last
 
     def _hung(self, now: float | None = None) -> tuple[bool, float | None]:
         """(hung?, beat_age_s). Hung = the loop thread is alive but its
@@ -957,6 +1317,17 @@ class ServingServer:
         consecutive = 0
         while not self._stop.is_set():
             self._last_beat = time.monotonic()
+            box = self._migrate_box
+            if box is not None:
+                # session export runs HERE because slot/device state is
+                # owned by this thread: between steps every slot is
+                # quiescent, so the snapshot is exact by construction
+                self._migrate_box = None
+                try:
+                    box["sessions"] = self.engine.export_sessions()
+                except Exception as e:
+                    box["error"] = f"{type(e).__name__}: {e}"
+                box["evt"].set()
             try:
                 progressed = self.engine.step()
                 consecutive = 0
@@ -1003,9 +1374,12 @@ class ServingServer:
         """Shut down; with ``drain_s > 0`` drain first: admission stops
         immediately (new submits 503) and in-flight/queued work gets up
         to ``drain_s`` seconds to finish. Requests still running AT the
-        drain deadline are preempted (cancelled through the engine, so
-        each straggler retires as CANCELLED with its partial stream and
-        its handler answers 499) rather than decoded to completion."""
+        drain deadline are live-migrated to ``migrate_targets`` when
+        configured (their clients get full completions from the
+        destination replica); leftovers are preempted (cancelled
+        through the engine, so each straggler retires as CANCELLED with
+        its partial stream and its handler answers 499) rather than
+        decoded to completion."""
         self._draining.set()
         if drain_s > 0:
             deadline = time.monotonic() + drain_s
@@ -1014,6 +1388,18 @@ class ServingServer:
                    and not self._engine_dead.is_set()
                    and not self.engine.idle):
                 time.sleep(0.005)
+            if (self._engine_thread.is_alive()
+                    and not self._engine_dead.is_set()
+                    and not self.engine.idle
+                    and self.migrate_targets):
+                # drain deadline hit with live sessions: move them to a
+                # healthy replica first — preemption below only gets
+                # whatever migration could not seat
+                try:
+                    self._migrate_sessions(list(self.migrate_targets))
+                except Exception as e:
+                    log_event(_log, "migrate_on_stop_failed",
+                              error=f"{type(e).__name__}: {e}")
             if (self._engine_thread.is_alive()
                     and not self._engine_dead.is_set()
                     and not self.engine.idle):

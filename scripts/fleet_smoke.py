@@ -34,6 +34,34 @@ import urllib.request
 
 BOOT_TIMEOUT_S = 240  # demo replicas compile their programs first
 
+# every HTTP call in this smoke derives its socket timeout from one
+# deadline budget and propagates the remainder downstream via
+# X-Deadline-Ms, so a wedged fleet fails the lane in bounded time
+# instead of hanging on an unbounded urlopen
+GET_BUDGET_S = 30.0
+GENERATE_BUDGET_S = 120.0
+
+
+def _deadline_headers(budget_s):
+    return {"X-Deadline-Ms": str(int(budget_s * 1000))}
+
+
+def get(addr, path, budget_s=GET_BUDGET_S):
+    url = f"http://{addr['host']}:{addr['port']}{path}"
+    req = urllib.request.Request(url, headers=_deadline_headers(budget_s))
+    with urllib.request.urlopen(req, timeout=budget_s) as r:
+        return r.read()
+
+
+def post_generate(addr, body, budget_s=GENERATE_BUDGET_S):
+    req = urllib.request.Request(
+        f"http://{addr['host']}:{addr['port']}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **_deadline_headers(budget_s)})
+    with urllib.request.urlopen(req, timeout=budget_s) as r:
+        return r.status, json.loads(r.read())
+
 
 def wait_port_file(path, procs, timeout=BOOT_TIMEOUT_S):
     t0 = time.time()
@@ -46,21 +74,6 @@ def wait_port_file(path, procs, timeout=BOOT_TIMEOUT_S):
                 return json.load(f)
         time.sleep(0.2)
     raise SystemExit(f"timed out waiting for {path}")
-
-
-def get(addr, path, timeout=30):
-    url = f"http://{addr['host']}:{addr['port']}{path}"
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.read()
-
-
-def post_generate(addr, body, timeout=120):
-    req = urllib.request.Request(
-        f"http://{addr['host']}:{addr['port']}/v1/generate",
-        data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return r.status, json.loads(r.read())
 
 
 def prom_value(text, series):

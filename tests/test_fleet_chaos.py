@@ -1,0 +1,1138 @@
+"""Fleet-level resilience: live KV session migration, the resilient
+RPC layer (deadlines / hedging / circuit breakers), seeded network
+chaos, and controller checkpoint + failover.
+
+The tentpole contract pinned here: under seeded partitions, latency,
+corruption and drains mid-generation, every request either completes
+or fails with a clean bounded-latency error — zero hangs, zero
+duplicate-token streams — and a session migrated mid-generation
+continues BYTE-IDENTICAL to an unmigrated reference, greedy and
+sampled alike, including across a crash on the destination replica.
+
+Fast unit and engine-level tests ride in tier-1; the heavier live-HTTP
+fleet scenarios carry ``fleet_chaos`` (the CI fleet-chaos lane selects
+them with ``-m fleet_chaos``) and the multi-replica ones are also
+``slow``.
+"""
+
+import http.client
+import json
+import queue
+import socket
+import tempfile
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from deeplearning4j_tpu.serving import (
+    ChaosProxy,
+    CircuitBreaker,
+    Deadline,
+    FaultInjector,
+    FleetController,
+    IdempotencyRegistry,
+    KVSessionRequest,
+    LatencyWindow,
+    Request,
+    RequestStatus,
+    ServingEngine,
+    ServingServer,
+    decode_segment,
+    encode_segment,
+    run_hedged,
+)
+from deeplearning4j_tpu.serving.router import ReplicaRouter
+from deeplearning4j_tpu.serving.rpc import CLOSED, HALF_OPEN, OPEN
+from deeplearning4j_tpu.utils.httpjson import QuietHandler, send_json
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=32
+)
+_PARAMS = {}
+
+
+def _params(seed=0):
+    if seed not in _PARAMS:
+        _PARAMS[seed] = init_transformer(jax.random.key(seed), CFG)
+    return _PARAMS[seed]
+
+
+def _name(srv) -> str:
+    return "%s:%d" % srv.address
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _prom_value(text: str, series: str) -> float:
+    """Value of one Prometheus sample line (series incl. labels)."""
+    for line in text.splitlines():
+        if line.startswith(series + " "):
+            return float(line.split()[-1])
+    raise AssertionError(f"{series} not found in exposition")
+
+
+# -- rpc: deadlines --------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_deadline_budget_and_header_propagation():
+    clk = _Clock()
+    dl = Deadline.from_header("2000", default_s=30.0, clock=clk)
+    assert dl.remaining_s() == pytest.approx(2.0)
+    # socket timeout = min(remaining, cap), never below the floor
+    assert dl.timeout(10.0) == pytest.approx(2.0)
+    assert dl.timeout(0.5) == pytest.approx(0.5)
+    clk.t += 1.99
+    assert dl.timeout(10.0) == pytest.approx(0.05)  # floor
+    assert dl.timeout(10.0, floor=0.0) == pytest.approx(0.01, abs=1e-6)
+    assert not dl.expired()
+    clk.t += 1.0
+    assert dl.expired() and dl.remaining_s() == 0.0
+    assert dl.header_value() == "1"  # never grants zero downstream
+
+
+def test_deadline_malformed_header_falls_back_to_default():
+    for bad in (None, "", "soon", "-5", "0", object()):
+        dl = Deadline.from_header(bad, default_s=7.0, clock=_Clock())
+        assert dl.remaining_s() == pytest.approx(7.0)
+
+
+# -- rpc: circuit breaker --------------------------------------------------
+
+
+def test_breaker_opens_after_consecutive_failures_only():
+    clk = _Clock()
+    br = CircuitBreaker(failure_threshold=3, reset_s=1.0, clock=clk)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # success resets the consecutive count
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow()  # backoff not elapsed
+
+
+def test_breaker_half_open_probe_and_exponential_backoff():
+    clk = _Clock()
+    transitions = []
+    br = CircuitBreaker(failure_threshold=1, reset_s=1.0, max_reset_s=3.0,
+                        clock=clk,
+                        on_transition=lambda o, n: transitions.append((o, n)))
+    br.record_failure()
+    assert br.state == OPEN
+    clk.t += 1.01
+    assert br.allow()  # THE half-open probe
+    assert br.state == HALF_OPEN
+    assert not br.allow()  # everyone else declined while probing
+    br.record_failure()  # probe failed -> re-open, backoff doubled
+    assert br.state == OPEN
+    clk.t += 1.01
+    assert not br.allow()  # 1s is no longer enough
+    clk.t += 1.01
+    assert br.allow()
+    br.record_success()
+    assert br.state == CLOSED
+    # success reset the backoff to the base interval
+    br.record_failure()
+    clk.t += 1.01
+    assert br.allow()
+    assert transitions == [
+        (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, OPEN),
+        (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED), (CLOSED, OPEN),
+        (OPEN, HALF_OPEN),
+    ]
+
+
+def test_breaker_snapshot_restore_is_probe_due():
+    clk = _Clock()
+    br = CircuitBreaker(failure_threshold=1, reset_s=1.0, clock=clk)
+    br.record_failure()
+    snap = br.snapshot()
+    assert snap["state"] == OPEN
+
+    br2 = CircuitBreaker(failure_threshold=1, reset_s=1.0, clock=_Clock())
+    br2.restore(snap)
+    # a restored OPEN breaker is due for its probe immediately: the
+    # standby re-verifies against live traffic, not a stale journal
+    assert br2.state == OPEN and br2.allow()
+    assert br2.state == HALF_OPEN
+
+    # a journaled HALF_OPEN restores as OPEN (probe owner died)
+    br3 = CircuitBreaker(clock=_Clock())
+    br3.restore({"state": HALF_OPEN, "failures": 1, "reset_s": 1.0})
+    assert br3.state == OPEN
+    br4 = CircuitBreaker(clock=_Clock())
+    br4.restore({"state": "garbled"})
+    assert br4.state == CLOSED
+
+
+def test_latency_window_default_until_min_samples():
+    w = LatencyWindow(cap=100, min_samples=5, default_s=2.5)
+    for x in (0.1, 0.2, 0.3):
+        w.record(x)
+    assert w.quantile(0.99) == 2.5  # warmup: no hedging storm
+    for x in (0.1, 0.2):
+        w.record(x)
+    assert w.quantile(0.99) <= 0.3
+    assert w.quantile(0.0) == pytest.approx(0.1)
+
+
+# -- rpc: hedging ----------------------------------------------------------
+
+
+def test_hedge_not_fired_when_primary_is_fast():
+    result, fired, winner = run_hedged(
+        lambda leg: f"leg{leg}", delay_s=5.0)
+    assert (result, fired, winner) == ("leg0", 1, 0)
+
+
+def test_hedge_fires_and_wins_when_primary_stalls():
+    hedged = []
+
+    def attempt(leg):
+        if leg == 0:
+            time.sleep(2.0)
+        return f"leg{leg}"
+
+    result, fired, winner = run_hedged(
+        attempt, delay_s=0.1, on_hedge=lambda: hedged.append(1))
+    assert (result, fired, winner) == ("leg1", 2, 1)
+    assert hedged == [1]
+
+
+def test_hedge_first_completion_wins_even_when_it_failed():
+    # a FAST failure completes before the hedge delay: no hedge fires
+    # (retry-on-failure is the caller's job; hedging is for stalls)
+    def attempt(leg):
+        raise OSError("primary died")
+
+    with pytest.raises(OSError, match="primary died"):
+        run_hedged(attempt, delay_s=5.0)
+
+
+def test_hedge_second_leg_rescues_failed_primary():
+    def attempt(leg):
+        if leg == 0:
+            time.sleep(0.1)
+            raise OSError("primary died late")
+        time.sleep(0.3)
+        return "hedge saved it"
+
+    result, fired, winner = run_hedged(attempt, delay_s=0.05)
+    assert (result, fired, winner) == ("hedge saved it", 2, 1)
+
+
+def test_hedge_respects_deadline_budget():
+    # primary stalls past the whole budget; the hedge would need more
+    # delay than remains, so it never fires and the wait stays bounded
+    dl = Deadline(0.3)
+    t0 = time.monotonic()
+    with pytest.raises(queue.Empty):
+        run_hedged(lambda leg: time.sleep(10.0), delay_s=0.5, deadline=dl)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_idempotency_registry_lru():
+    reg = IdempotencyRegistry(cap=3)
+    assert reg.first_seen("a") and not reg.first_seen("a")
+    assert reg.first_seen("b") and reg.first_seen("c")
+    _ = reg.first_seen("a")  # touch -> MRU
+    assert reg.first_seen("d")  # evicts b (LRU)
+    assert reg.first_seen("b")  # b was forgotten
+    assert not reg.first_seen("a")
+    # unkeyed requests are never deduped
+    assert reg.first_seen("") and reg.first_seen("")
+
+
+# -- netfaults: the chaos proxy -------------------------------------------
+
+
+class _EchoHTTP:
+    """Tiny HTTP target: GET /ping -> 200 json; POST /echo -> length."""
+
+    def __init__(self):
+        class Handler(QuietHandler):
+            def do_GET(self):
+                send_json(self, 200, {"pong": True})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                data = self.rfile.read(n)
+                send_json(self, 200, {"nbytes": len(data),
+                                      "payload": data.decode("latin-1")})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def address(self):
+        return self._httpd.server_address[:2]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _http(addr, method, path, body=b"", timeout=5.0, headers=None):
+    if isinstance(addr, str):
+        host, port = addr.rsplit(":", 1)
+        addr = (host, int(port))
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request(method, path, body=body or None, headers=headers or {})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def test_chaos_proxy_passthrough_and_counters():
+    target = _EchoHTTP()
+    proxy = ChaosProxy(target.address, seed=7)
+    try:
+        status, data = _http(proxy.address, "GET", "/ping")
+        assert status == 200 and json.loads(data)["pong"]
+        status, data = _http(proxy.address, "POST", "/echo",
+                             body=b"x" * 500)
+        assert status == 200 and json.loads(data)["nbytes"] == 500
+        assert proxy.n_connections == 2
+        assert all(v == 0 for v in proxy.counts.values())
+    finally:
+        proxy.stop()
+        target.stop()
+
+
+def test_chaos_proxy_refuse_partition_and_drop_are_bounded():
+    target = _EchoHTTP()
+    proxy = ChaosProxy(target.address, seed=7).plan("refuse", at=0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            _http(proxy.address, "GET", "/ping", timeout=3.0)
+        assert time.monotonic() - t0 < 3.5
+        assert proxy.counts["refuse"] == 1
+
+        proxy.set_partition(True)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            _http(proxy.address, "GET", "/ping", timeout=3.0)
+        assert time.monotonic() - t0 < 3.5
+        assert proxy.counts["refused_partition"] == 1
+        proxy.set_partition(False)
+        status, _ = _http(proxy.address, "GET", "/ping")  # heals
+        assert status == 200
+
+        proxy.plan("drop", at=proxy.n_connections)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            _http(proxy.address, "GET", "/ping", timeout=3.0)
+        assert time.monotonic() - t0 < 3.5
+        assert proxy.counts["drop"] == 1
+    finally:
+        proxy.stop()
+        target.stop()
+
+
+def test_chaos_proxy_truncates_and_corrupts():
+    target = _EchoHTTP()
+    proxy = ChaosProxy(target.address, seed=7)
+    try:
+        # truncate: the client sees a mid-frame cut, never a hang and
+        # never a silently complete 200
+        proxy.plan("truncate", at=proxy.n_connections)
+        t0 = time.monotonic()
+        complete = None
+        try:
+            status, data = _http(proxy.address, "POST", "/echo",
+                                 body=b"y" * 4096, timeout=3.0)
+            if status == 200:
+                complete = json.loads(data)["nbytes"]
+        except Exception:
+            pass
+        assert complete is None
+        assert time.monotonic() - t0 < 3.5
+        assert proxy.counts["truncate"] == 1
+
+        # corrupt: bytes flipped in the first client->server chunk —
+        # the server sees a mangled request, answers an error or hangs
+        # up; either way the client fails clean
+        proxy.plan("corrupt", at=proxy.n_connections)
+        t0 = time.monotonic()
+        try:
+            status, _ = _http(proxy.address, "POST", "/echo",
+                              body=b"z" * 64, timeout=3.0)
+            assert status >= 400
+        except Exception:
+            pass
+        assert time.monotonic() - t0 < 3.5
+        assert proxy.counts["corrupt"] == 1
+    finally:
+        proxy.stop()
+        target.stop()
+
+
+def test_chaos_proxy_seeded_rates_replay():
+    draws = []
+    for _ in range(2):
+        target = _EchoHTTP()
+        proxy = ChaosProxy(target.address, seed=42, refuse_rate=0.5)
+        try:
+            outcomes = []
+            for _i in range(8):
+                try:
+                    status, _ = _http(proxy.address, "GET", "/ping",
+                                      timeout=3.0)
+                    outcomes.append(status == 200)
+                except OSError:
+                    outcomes.append(False)
+            draws.append(tuple(outcomes))
+            assert proxy.counts["refuse"] >= 1
+        finally:
+            proxy.stop()
+            target.stop()
+    assert draws[0] == draws[1]  # same seed -> same chaos
+
+
+# -- live session migration: engine level ---------------------------------
+
+
+def _step_until_generated(eng, req, n=2, max_steps=500):
+    """Drive the engine loop until ``req`` has >= n tokens but is not
+    finished — the mid-generation export point."""
+    for _ in range(max_steps):
+        eng.step()
+        assert not req.done.is_set(), "finished before the export point"
+        for st in eng._slots:
+            if st is not None and st.req is req and len(st.tokens) >= n:
+                return len(st.tokens)
+    raise AssertionError("never reached the export point")
+
+
+def _drain_one(engine, req, max_steps=500):
+    engine.submit(req)
+    for _ in range(max_steps):
+        engine.step()
+        if req.done.is_set():
+            return req
+    raise AssertionError(f"request {req.id} never finished")
+
+
+def _session_frame(sess):
+    return encode_segment(
+        config_hash=sess["config_hash"], tokens=sess["tokens"],
+        leaves=sess["leaves"], logits=sess["logits"],
+        layout=sess["layout"], block_size=sess["block_size"],
+        gen=sess["gen"],
+    )
+
+
+def _seat_request(seg, prompt):
+    gen = seg["gen"]
+    return KVSessionRequest(
+        prompt=[int(t) for t in prompt],
+        max_new=int(gen["max_new"]),
+        eos_token=(None if gen.get("eos_token") is None
+                   else int(gen["eos_token"])),
+        segment=seg,
+        gen_tokens=tuple(int(t) for t in gen["tokens"]),
+        key_data=np.asarray(gen["key_data"], np.uint32),
+        done=threading.Event(),
+    )
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8],
+                         ids=["greedy", "sampled"])
+def test_migration_mid_generation_is_byte_identical(temperature):
+    """Export a LIVE slot mid-generation, ship it over the KVSG wire,
+    seat it on a different engine (different rng seed — the sampling
+    key must travel), finish there: the full stream is byte-identical
+    to an engine that never migrated."""
+    prompt = list(np.random.default_rng(21).integers(1, 60, 8))
+    kw = dict(n_slots=2, temperature=temperature, decode_horizon=2)
+    src = ServingEngine(CFG, _params(), rng_seed=5, **kw)
+    dst = ServingEngine(CFG, _params(), rng_seed=99, **kw)
+    mono = ServingEngine(CFG, _params(), rng_seed=5, **kw)
+
+    req = Request(prompt=np.asarray(prompt, np.int32), max_new=10,
+                  done=threading.Event())
+    src.submit(req)
+    _step_until_generated(src, req, n=2)
+    sessions = src.export_sessions()
+    assert len(sessions) == 1
+    sess = sessions[0]
+    assert not req.done.is_set()  # parked, not failed
+    assert all(st is None for st in src._slots)  # slot freed
+
+    seg = decode_segment(_session_frame(sess),
+                         expect_hash=dst.config_hash)
+    assert seg["gen"]["req_id"] == req.id
+    seat = _drain_one(dst, _seat_request(seg, prompt))
+    assert seat.status == RequestStatus.FINISHED, seat.error
+    assert seat.result["seated"] is True
+    migrated = dst.pop_result(seat.id)
+
+    ref_req = _drain_one(mono, Request(
+        prompt=np.asarray(prompt, np.int32), max_new=10,
+        done=threading.Event()))
+    ref = mono.pop_result(ref_req.id)
+    np.testing.assert_array_equal(migrated, ref)
+
+    # settle the parked source request with the destination's bytes
+    src.complete_migrated(sess["req"], migrated,
+                          n_streamed=sess["n_streamed"])
+    assert req.done.is_set() and req.status == RequestStatus.FINISHED
+    np.testing.assert_array_equal(src.pop_result(req.id), ref)
+
+    kinds = [e[2] for e in src.flight._events]
+    assert "migrate_out" in kinds and "migrate_settled" in kinds
+    assert "migrate_seated" in [e[2] for e in dst.flight._events]
+
+
+def test_migration_seat_survives_destination_crash_recovery():
+    """The destination crashes AFTER seating a migrated (sampled)
+    session; supervised recovery replays prompt + tokens-so-far with
+    the migrated key and the final stream still matches the
+    unmigrated reference byte for byte."""
+    prompt = list(np.random.default_rng(23).integers(1, 60, 8))
+    kw = dict(n_slots=2, temperature=0.8, decode_horizon=2,
+              retry_backoff_s=0.001, max_backoff_s=0.004)
+    src = ServingEngine(CFG, _params(), rng_seed=5, **kw)
+    dst = ServingEngine(
+        CFG, _params(), rng_seed=99,
+        faults=FaultInjector().plan("step", at=1, kind="crash"), **kw)
+    mono = ServingEngine(CFG, _params(), rng_seed=5, **kw)
+
+    req = Request(prompt=np.asarray(prompt, np.int32), max_new=10,
+                  done=threading.Event())
+    src.submit(req)
+    _step_until_generated(src, req, n=2)
+    sess = src.export_sessions()[0]
+    seg = decode_segment(_session_frame(sess),
+                         expect_hash=dst.config_hash)
+
+    seat = _seat_request(seg, prompt)
+    dst.submit(seat)
+    dst.run()  # supervised: seat -> crash -> replay recovery -> finish
+    assert dst.metrics.n_restarts == 1
+    assert seat.status == RequestStatus.FINISHED, seat.error
+
+    ref_req = _drain_one(mono, Request(
+        prompt=np.asarray(prompt, np.int32), max_new=10,
+        done=threading.Event()))
+    np.testing.assert_array_equal(dst.pop_result(seat.id),
+                                  mono.pop_result(ref_req.id))
+    src.complete_migrated(sess["req"], list(req.prompt))  # unpark
+
+
+def test_migration_declines_are_soft():
+    """Hash-foreign, key-shape-foreign and token-count-inconsistent
+    sessions are declined with ``seated=False`` + a reason — and the
+    engine keeps serving ordinary traffic afterwards."""
+    prompt = list(np.random.default_rng(25).integers(1, 60, 8))
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2)
+    src = ServingEngine(CFG, _params(), **kw)
+    dst = ServingEngine(CFG, _params(), **kw)
+
+    req = Request(prompt=np.asarray(prompt, np.int32), max_new=8,
+                  done=threading.Event())
+    src.submit(req)
+    _step_until_generated(src, req, n=2)
+    sess = src.export_sessions()[0]
+    seg = decode_segment(_session_frame(sess))
+
+    foreign = dict(seg)
+    foreign["config_hash"] = "f" * 64
+    r = _drain_one(dst, _seat_request(foreign, prompt))
+    assert r.status == RequestStatus.FAILED
+    assert r.result["seated"] is False and "hash" in r.result["reason"]
+
+    bad_key = dict(seg, gen=dict(seg["gen"], key_data=[1, 2, 3, 4, 5, 6]))
+    r = _drain_one(dst, _seat_request(bad_key, prompt))
+    assert r.result["seated"] is False
+    assert "sampling key" in r.result["reason"]
+
+    # frame/claim mismatch: drop a generated token from the gen block
+    short = dict(seg, gen=dict(seg["gen"],
+                               tokens=seg["gen"]["tokens"][:-1]))
+    r = _drain_one(dst, _seat_request(short, prompt))
+    assert r.result["seated"] is False and "covers" in r.result["reason"]
+
+    assert "migrate_declined" in [e[2] for e in dst.flight._events]
+    out = _drain_one(dst, Request(prompt=np.asarray(prompt, np.int32),
+                                  max_new=4, done=threading.Event()))
+    assert out.status == RequestStatus.FINISHED  # still serving
+    src.complete_migrated(sess["req"], list(req.prompt))
+
+
+# -- live session migration + wire robustness: over HTTP ------------------
+
+
+def _post(addr, path, body, headers=None, timeout=60):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers=h)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read()), r.getheader("X-Served-By")
+    finally:
+        conn.close()
+
+
+def _post_frame(addr, frame, idem="", timeout=60):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        h = {"Content-Type": "application/octet-stream"}
+        if idem:
+            h["X-Idempotency-Key"] = idem
+        conn.request("POST", "/v1/kv_session", body=frame, headers=h)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def _wait_live_slot(eng, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if any(st is not None for st in eng._slots):
+            return True
+        time.sleep(0.002)
+    return False
+
+
+@pytest.mark.fleet_chaos
+@pytest.mark.slow
+def test_kv_session_wire_robustness_soft_declines_and_fallback():
+    """Mid-frame truncation, corrupt header bytes and duplicate pushes
+    all yield clean 4xx declines — and the receiver still serves the
+    seat and the monolithic fallback leg byte-identically. Never a
+    hang, never a wrong-answer stream."""
+    prompt = list(np.random.default_rng(31).integers(1, 60, 8))
+    kw = dict(n_slots=2, temperature=0.8, decode_horizon=2)
+    src = ServingEngine(CFG, _params(), rng_seed=5, **kw)
+    mono = ServingEngine(CFG, _params(), rng_seed=5, **kw)
+    dst_eng = ServingEngine(CFG, _params(), rng_seed=99, **kw)
+    dst = ServingServer(dst_eng, port=0).start()
+    try:
+        req = Request(prompt=np.asarray(prompt, np.int32), max_new=10,
+                      done=threading.Event())
+        src.submit(req)
+        _step_until_generated(src, req, n=2)
+        sess = src.export_sessions()[0]
+        frame = _session_frame(sess)
+
+        # truncation mid-frame -> 400, engine never touched
+        status, body = _post_frame(dst.address, frame[: len(frame) // 2],
+                                   timeout=30)
+        assert status == 400, body
+
+        # corrupt header bytes (the JSON header starts at offset 10,
+        # right after the <magic, version, header_len> preamble) -> 400
+        mangled = bytearray(frame)
+        for i in range(10, 26):
+            mangled[i] ^= 0xFF
+        status, body = _post_frame(dst.address, bytes(mangled), timeout=30)
+        assert status == 400, body
+
+        # a plain (no-gen) segment frame is not a session -> 400
+        plain = encode_segment(
+            config_hash=sess["config_hash"], tokens=sess["tokens"],
+            leaves=sess["leaves"], logits=sess["logits"],
+            layout=sess["layout"], block_size=sess["block_size"])
+        status, body = _post_frame(dst.address, plain, timeout=30)
+        assert status == 400 and "gen" in body["error"]
+
+        # the intact frame seats and completes with reference bytes
+        status, body = _post_frame(dst.address, frame, idem="mig-k1",
+                                   timeout=60)
+        assert status == 200 and body["status"] == "finished", body
+        ref_req = _drain_one(mono, Request(
+            prompt=np.asarray(prompt, np.int32), max_new=10,
+            done=threading.Event()))
+        ref = [int(t) for t in mono.pop_result(ref_req.id)]
+        assert body["tokens"] == ref
+
+        # duplicate push (hedge loser / retransmit) -> 409, dedup'd
+        status, body = _post_frame(dst.address, frame, idem="mig-k1",
+                                   timeout=30)
+        assert status == 409 and body["duplicate"] is True
+
+        # the monolithic fallback leg still answers, byte-identical.
+        # Seating installs the migrated key VERBATIM without splitting
+        # the destination's own key chain, so its first local
+        # admission samples exactly like a fresh seed-99 engine.
+        mono2 = ServingEngine(CFG, _params(), rng_seed=99, **kw)
+        ref2_req = _drain_one(mono2, Request(
+            prompt=np.asarray(prompt, np.int32), max_new=4,
+            done=threading.Event()))
+        status, body, _ = _post(dst.address, "/v1/generate",
+                                {"prompt": [int(t) for t in prompt],
+                                 "max_new": 4})
+        assert status == 200
+        assert body["tokens"] == [int(t) for t in
+                                  mono2.pop_result(ref2_req.id)]
+        src.complete_migrated(sess["req"], ref)
+    finally:
+        dst.stop()
+
+
+@pytest.mark.fleet_chaos
+@pytest.mark.slow
+def test_kv_session_push_through_chaos_proxy_never_hangs():
+    """A session push whose transport is cut (request dropped /
+    response truncated) fails CLEANLY within its timeout; the receiver
+    keeps serving and still seats the frame sent directly."""
+    prompt = list(np.random.default_rng(33).integers(1, 60, 8))
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2)
+    src = ServingEngine(CFG, _params(), **kw)
+    dst_eng = ServingEngine(CFG, _params(), **kw)
+    dst = ServingServer(dst_eng, port=0).start()
+    proxy = ChaosProxy(dst.address, seed=3)
+    via_proxy = ("127.0.0.1", proxy.port)
+    try:
+        req = Request(prompt=np.asarray(prompt, np.int32), max_new=10,
+                      done=threading.Event())
+        src.submit(req)
+        _step_until_generated(src, req, n=2)
+        sess = src.export_sessions()[0]
+        frame = _session_frame(sess)
+
+        proxy.plan("drop", at=proxy.n_connections)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            _post_frame(via_proxy, frame, timeout=5)
+        assert time.monotonic() - t0 < 6.0
+
+        proxy.plan("truncate", at=proxy.n_connections)
+        t0 = time.monotonic()
+        complete = None
+        try:
+            status, body = _post_frame(via_proxy, frame, timeout=5)
+            if status == 200:
+                complete = body
+        except Exception:
+            pass
+        assert complete is None
+        assert time.monotonic() - t0 < 6.0
+
+        # receiver unharmed: the same frame seats fine sent directly
+        status, body = _post_frame(dst.address, frame, timeout=60)
+        assert status == 200 and body["status"] == "finished", body
+        src.complete_migrated(sess["req"], body["tokens"])
+    finally:
+        proxy.stop()
+        dst.stop()
+
+
+@pytest.mark.fleet_chaos
+@pytest.mark.slow
+def test_http_migration_mid_generation_parity():
+    """POST /migrate on a replica with an in-flight sampled generate:
+    the session re-seats on the target replica and the ORIGINAL
+    blocked client gets the destination's bytes — identical to an
+    unmigrated reference. Zero duplicate tokens, zero losses."""
+    prompt = list(np.random.default_rng(35).integers(1, 60, 8))
+    kw = dict(n_slots=2, temperature=0.8, decode_horizon=2)
+    # delay_s throttles every engine boundary so the generate is
+    # reliably still in flight when /migrate lands
+    src_eng = ServingEngine(CFG, _params(), rng_seed=5,
+                            faults=FaultInjector(delay_s=0.05), **kw)
+    dst_eng = ServingEngine(CFG, _params(), rng_seed=99, **kw)
+    mono = ServingEngine(CFG, _params(), rng_seed=5, **kw)
+    dst = ServingServer(dst_eng, port=0).start()
+    src = ServingServer(src_eng, port=0,
+                        migrate_targets=(_name(dst),)).start()
+    try:
+        out = {}
+
+        def client():
+            out["resp"] = _post(src.address, "/v1/generate",
+                                {"prompt": [int(t) for t in prompt],
+                                 "max_new": 16},
+                                timeout=120)
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert _wait_live_slot(src_eng), "generate never admitted"
+
+        status, res, _ = _post(src.address, "/migrate", {}, timeout=60)
+        assert status == 200, res
+        assert res["exported"] == 1 and res["migrated"] == 1, res
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "client hung across migration"
+        status, body, _ = out["resp"]
+        assert status == 200, body
+
+        ref_req = _drain_one(mono, Request(
+            prompt=np.asarray(prompt, np.int32), max_new=16,
+            done=threading.Event()), max_steps=1000)
+        ref = [int(x) for x in mono.pop_result(ref_req.id)]
+        assert body["tokens"] == ref
+
+        src_kinds = [e[2] for e in src_eng.flight._events]
+        assert "migrate_out" in src_kinds
+        assert "migrate_push" in src_kinds
+        assert "migrate_settled" in src_kinds
+        assert "migrate_seated" in [e[2] for e in dst_eng.flight._events]
+        # PR-14 redaction holds: migration events carry ids and
+        # counts, never raw token content
+        bundle = src_eng.flight.dump("test")
+        for ev in bundle["events"]:
+            if str(ev["kind"]).startswith("migrate"):
+                assert not isinstance(ev.get("tokens"), list)
+                assert not isinstance(ev.get("prompt"), list)
+    finally:
+        src.stop()
+        dst.stop()
+
+
+# -- router: breakers, deadlines, partitions ------------------------------
+
+
+@pytest.mark.fleet_chaos
+@pytest.mark.slow
+def test_router_partition_bounded_breaker_cycle():
+    """A partitioned replica yields bounded 5xx (never a hang), opens
+    its breaker after consecutive failures, health polls alone do NOT
+    close it, and one successful half-open probe does."""
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2)
+    eng = ServingEngine(CFG, _params(), **kw)
+    srv = ServingServer(eng, port=0).start()
+    proxy = ChaosProxy(srv.address, seed=1)
+    name = proxy.address
+    router = ReplicaRouter([("127.0.0.1", proxy.port)],
+                           health_interval_s=600.0)
+    try:
+        router.poll_health()  # pin identity through the proxy
+        st = router.replica_states()[name]
+        assert st["healthy"] and st["breaker"]["state"] == CLOSED
+
+        proxy.set_partition(True)
+        t0 = time.monotonic()
+        status, payload, served = router.route(
+            {"prompt": [1, 2, 3, 4], "max_new": 2}, deadline_ms="3000")
+        assert status in (503, 504) and served is None
+        assert time.monotonic() - t0 < 5.0  # bounded, no hang
+        # failed polls also count against the breaker (but successful
+        # ones never close it — only a real probe request may)
+        router.poll_health()
+        router.poll_health()
+        assert router.replica_states()[name]["breaker"]["state"] == OPEN
+
+        proxy.set_partition(False)
+        router.poll_health()
+        st = router.replica_states()[name]
+        assert st["healthy"]
+        assert st["breaker"]["state"] == OPEN
+        # breaker open: routing declines fast instead of dispatching
+        t0 = time.monotonic()
+        status, payload, served = router.route(
+            {"prompt": [1, 2, 3, 4], "max_new": 2})
+        assert status == 503 and served is None
+        assert time.monotonic() - t0 < 2.0
+
+        time.sleep(1.05)  # breaker backoff elapses -> probe due
+        status, payload, served = router.route(
+            {"prompt": [1, 2, 3, 4], "max_new": 2})
+        assert status == 200 and served == name
+        assert router.replica_states()[name]["breaker"]["state"] == CLOSED
+        assert "breaker" in [e[2] for e in router.flight._events]
+    finally:
+        router._httpd.server_close()  # never start()ed: close the sock
+        proxy.stop()
+        srv.stop()
+
+
+# -- controller: session LRU, journal + failover --------------------------
+
+
+def test_session_lru_evicts_idle_before_active():
+    """The stickiness map is bounded; an idle pinned session is
+    evicted before one that routed recently, and the eviction is
+    counted."""
+    ctl = FleetController(
+        ["127.0.0.1:1=decode", "127.0.0.1:2=decode"],
+        session_cap=2, health_interval_s=600.0)
+    try:
+        ctl._note_session("s1", "127.0.0.1:1")
+        ctl._note_session("s2", "127.0.0.1:2")
+        # s1 routes again: the sticky hit refreshes its LRU position
+        member, how = ctl._pick_decode([1, 2, 3], "s1", set())
+        assert how == "sticky" and member.name == "127.0.0.1:1"
+        ctl._note_session("s3", "127.0.0.1:2")  # cap 2 -> evict ONE
+        assert "s1" in ctl._sessions  # active survived
+        assert "s2" not in ctl._sessions  # idle pinned was evicted
+        assert "s3" in ctl._sessions
+        assert _prom_value(ctl.registry.render(),
+                           "fleet_sessions_evicted_total") == 1
+    finally:
+        ctl._httpd.server_close()  # never start()ed
+
+
+@pytest.mark.fleet_chaos
+@pytest.mark.slow
+def test_controller_journal_failover_and_standby_gate():
+    """The warm standby answers 503 while the primary lives, then
+    promotes from the journal after consecutive missed health checks —
+    restoring roles, stickiness and breaker state — and re-verifies
+    against the live fleet."""
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2)
+    srv = ServingServer(ServingEngine(CFG, _params(), **kw),
+                        port=0).start()
+    live = _name(srv)
+    dead = f"127.0.0.1:{_dead_port()}"
+    jpath = tempfile.mktemp(prefix="fleet-journal-", suffix=".json")
+    specs = [live, f"{dead}=prefill"]
+    primary = FleetController(specs, journal=jpath,
+                              health_interval_s=600.0).start()
+    standby = FleetController(
+        specs, journal=jpath, health_interval_s=0.05,
+        standby_of="%s:%d" % primary.address,
+        failover_after=3).start()
+    try:
+        # standby refuses traffic while the primary is up
+        status, body, _ = _post(standby.address, "/v1/generate",
+                                {"prompt": [1, 2, 3], "max_new": 1})
+        assert status == 503 and body.get("standby") is True
+        status, body, _ = _post(standby.address, "/fleet/drain",
+                                {"replica": live})
+        assert status == 503 and body.get("standby") is True
+
+        # mutate fleet state on the primary; every change journals
+        status, body, _ = _post(primary.address, "/fleet/role",
+                                {"replica": dead, "role": "decode"})
+        assert status == 200, body
+        primary._note_session("conv-9", live)
+        for _ in range(3):
+            primary._member(dead).breaker.record_failure()
+        primary._write_journal()
+        with open(jpath, encoding="utf-8") as f:
+            journal = json.load(f)
+        assert journal["roles"][dead] == "decode"
+        assert ["conv-9", live] in journal["sessions"]
+        assert journal["breakers"][dead]["state"] == OPEN
+
+        primary.stop()  # primary dies; standby notices missed polls
+        t0 = time.monotonic()
+        while not standby.fleet_state()["active"]:
+            assert time.monotonic() - t0 < 20.0, "standby never promoted"
+            time.sleep(0.05)
+
+        st = standby.fleet_state()
+        assert st["replicas"][dead]["role"] == "decode"
+        assert st["replicas"][dead]["breaker"]["state"] == OPEN
+        assert "conv-9" in standby._sessions
+        assert _prom_value(standby.registry.render(),
+                           "fleet_failovers_total") == 1
+        assert "failover" in [e[2] for e in standby.flight._events]
+        # promoted: requests route again, served by the live replica
+        status, body, served = _post(standby.address, "/v1/generate",
+                                     {"prompt": [1, 2, 3], "max_new": 1})
+        assert status == 200 and served == live, body
+    finally:
+        try:
+            primary.stop()
+        except Exception:
+            pass
+        standby.stop()
+        srv.stop()
+
+
+# -- controller: hedged transfer leg --------------------------------------
+
+
+@pytest.mark.fleet_chaos
+@pytest.mark.slow
+def test_hedged_transfer_leg_fires_and_wins():
+    """The idempotent transfer leg hedges onto the second prefill
+    replica when the primary stalls past the hedge delay; the hedge
+    wins, the request completes with parity bytes, and the loser's
+    late duplicate push is dedup'd by the decode replica."""
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2)
+    pf0 = ServingServer(ServingEngine(CFG, _params(), **kw),
+                        port=0).start()
+    pf1 = ServingServer(ServingEngine(CFG, _params(), **kw),
+                        port=0).start()
+    dc_eng = ServingEngine(CFG, _params(), prefix_cache=True, **kw)
+    dc = ServingServer(dc_eng, port=0).start()
+    mono = ServingEngine(CFG, _params(), **kw)
+    # the chaos proxy will stall the PRIMARY prefill leg well past the
+    # warm-up hedge delay (LatencyWindow default 1.0s on a fresh
+    # controller); health traffic before the plan flows clean
+    proxy = ChaosProxy(pf0.address, seed=5, latency_s=2.5)
+    pf0_name = proxy.address
+    ctl = FleetController(
+        [f"{pf0_name}=prefill", f"{_name(pf1)}=prefill",
+         f"{_name(dc)}=decode"],
+        disagg_threshold=12, health_interval_s=600.0,
+    ).start()
+    try:
+        # let the startup health sweep finish so its proxy connections
+        # are not the ones the latency plan lands on
+        t0 = time.monotonic()
+        while (ctl._member(pf0_name).last_health is None
+               and time.monotonic() - t0 < 30.0):
+            time.sleep(0.02)
+        assert ctl._member(pf0_name).last_health is not None
+        time.sleep(0.2)
+        proxy.plan("latency", at=proxy.n_connections, times=8)
+
+        prompt = [int(t) for t in
+                  np.random.default_rng(41).integers(1, 60, 16)]
+        t0 = time.monotonic()
+        status, body, served = _post(ctl.address, "/v1/generate",
+                                     {"prompt": prompt, "max_new": 4},
+                                     timeout=90)
+        elapsed = time.monotonic() - t0
+        assert status == 200, body
+        assert served == _name(dc)
+        assert elapsed < 30.0  # hedge rescued the stalled transfer
+
+        ref = _drain_one(mono, Request(
+            prompt=np.asarray(prompt, np.int32), max_new=4,
+            done=threading.Event()))
+        assert body["tokens"] == [int(t) for t in
+                                  mono.pop_result(ref.id)]
+
+        prom = ctl.registry.render()
+        assert _prom_value(prom,
+                           'fleet_hedges_total{result="fired"}') == 1
+        assert _prom_value(prom, 'fleet_hedges_total{result="won"}') == 1
+        kinds = [e[2] for e in ctl.flight._events]
+        assert "hedge_fired" in kinds and "hedge_won" in kinds
+    finally:
+        ctl.stop()
+        proxy.stop()
+        for s in (pf0, pf1, dc):
+            s.stop()
+
+
+# -- full fleet: 1 controller + 1 standby + 3 replicas --------------------
+
+
+@pytest.mark.fleet_chaos
+@pytest.mark.slow
+def test_fleet_chaos_partition_migration_failover_smoke():
+    """The CI fleet-chaos topology in-process: a controller with a
+    warm standby over three replicas, under a seeded partition, a
+    drain-with-migration mid-generation, and a primary-controller
+    crash — every request completes or fails bounded, the migrated
+    stream is byte-identical, and the standby takes over from the
+    journal."""
+    kw = dict(n_slots=2, temperature=0.0, decode_horizon=2)
+    r1_eng = ServingEngine(CFG, _params(),
+                           faults=FaultInjector(delay_s=0.05), **kw)
+    r1 = ServingServer(r1_eng, port=0).start()
+    r2 = ServingServer(ServingEngine(CFG, _params(), **kw),
+                       port=0).start()
+    r3 = ServingServer(ServingEngine(CFG, _params(), **kw),
+                       port=0).start()
+    proxy = ChaosProxy(r3.address, seed=11)  # r3 joins via the proxy
+    r1n, r3n = _name(r1), proxy.address
+    jpath = tempfile.mktemp(prefix="fleet-journal-", suffix=".json")
+    specs = [r1n, _name(r2), r3n]
+    primary = FleetController(specs, journal=jpath,
+                              health_interval_s=0.2).start()
+    standby = FleetController(
+        specs, journal=jpath, health_interval_s=0.1,
+        standby_of="%s:%d" % primary.address,
+        failover_after=3).start()
+    try:
+        # phase 1: routing under an asymmetric partition stays clean
+        proxy.set_partition(True)
+        for i in range(4):
+            t0 = time.monotonic()
+            status, body, served = _post(primary.address, "/v1/generate",
+                                         {"prompt": [3, 5, 7, 11 + i],
+                                          "max_new": 2},
+                                         timeout=90)
+            assert status == 200, body  # rerouted around the partition
+            assert served != r3n
+            assert time.monotonic() - t0 < 60.0
+        proxy.set_partition(False)
+
+        # phase 2: drain r1 with migration while it decodes
+        prompt = [int(t) for t in
+                  np.random.default_rng(43).integers(1, 60, 8)]
+        out = {}
+
+        def client():
+            out["resp"] = _post(r1.address, "/v1/generate",
+                                {"prompt": prompt, "max_new": 16},
+                                timeout=120)
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert _wait_live_slot(r1_eng), "generate never admitted"
+        status, body, _ = _post(primary.address, "/fleet/drain",
+                                {"replica": r1n, "migrate": True},
+                                timeout=90)
+        assert status == 200, body
+        assert body["draining"] is True
+        assert body["migration"].get("migrated") == 1, body
+        t.join(timeout=120)
+        assert not t.is_alive(), "client hung across drain+migration"
+        status, resp, _ = out["resp"]
+        assert status == 200, resp
+        mono = ServingEngine(CFG, _params(), **kw)
+        ref = _drain_one(mono, Request(
+            prompt=np.asarray(prompt, np.int32), max_new=16,
+            done=threading.Event()), max_steps=1000)
+        assert resp["tokens"] == [int(x) for x in
+                                  mono.pop_result(ref.id)]
+        assert _prom_value(primary.registry.render(),
+                           'fleet_migrations_total{result="ok"}') == 1
+
+        # phase 3: primary dies; the standby promotes from the journal
+        primary.stop()
+        t0 = time.monotonic()
+        while not standby.fleet_state()["active"]:
+            assert time.monotonic() - t0 < 30.0, "standby never promoted"
+            time.sleep(0.05)
+        assert standby.fleet_state()["replicas"][r1n]["draining"]
+        status, body, served = _post(standby.address, "/v1/generate",
+                                     {"prompt": [2, 4, 6, 8],
+                                      "max_new": 2},
+                                     timeout=90)
+        assert status == 200, body  # served by r2/r3, not drained r1
+        assert served != r1n
+        status, body, _ = _post(standby.address, "/fleet/undrain",
+                                {"replica": r1n}, timeout=60)
+        assert status == 200 and body["draining"] is False
+    finally:
+        try:
+            primary.stop()
+        except Exception:
+            pass
+        standby.stop()
+        proxy.stop()
+        for s in (r1, r2, r3):
+            s.stop()
