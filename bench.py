@@ -72,6 +72,12 @@ per workload — the driver's round record captures all of them:
                   served through the radix-tree prefix cache: headlines
                   TTFT p50 and prefill-tokens-saved, with the
                   cache-off replay in-row pricing what reuse buys
+- ``transformer-decode-serve-piggyback`` the 0.5 shared-prefix serve
+                  trace with a few injected 8k prompts, served with
+                  chunked-prefill piggyback on vs blocking admission:
+                  headlines p99 TPOT (decode streams stop stalling
+                  behind monolithic prefills), p50/p99 TTFT on-vs-off,
+                  and prefill-stall seconds in-row
 - ``transformer-decode-serve-tp`` the serve trace at a fixed global
                   batch with the fused decode program + KV pool sharded
                   over TP in {1,2,4,8} devices: headlines per-chip
@@ -1031,6 +1037,132 @@ def _bench_decode_serve_prefix(args, n_slots: int = 16,
         "n_requests": n_requests,
     }
     metric = ("transformer_gpt2s_h128_decode_serve_prefix_"
+              "tokens_per_sec_per_chip")
+    return tok_per_sec, metric, extra
+
+
+def _bench_decode_serve_piggyback(args, n_slots: int = 4,
+                                  n_requests: int = 24,
+                                  n_long: int = 4,
+                                  long_len: int = 8192,
+                                  mean_interarrival_s: float = 0.02):
+    """Chunked-prefill piggyback vs blocking admission on a mixed
+    trace: the 0.5 shared-prefix serve trace with a few 8k-token
+    prompts injected. Off, each 8k admission runs one monolithic
+    prefill while every active stream's next token waits behind it
+    (head-of-line blocking inside a single engine); on, the prompt is
+    split into pow2 chunks and at most ``prefill_budget`` chunk tokens
+    ride along per decode horizon — the last budgeted chunk fused into
+    the decode dispatch itself. Headlines are p99 TPOT (the stall the
+    active streams stop paying) and p50/p99 TTFT on-vs-off (the 8k
+    prompts now prefill incrementally, so their first token may arrive
+    later — the row prices that trade), plus ``prefill_stall_s``
+    (decode-blocked prefill seconds, measured identically in both
+    modes). Byte-parity of on/off streams is pinned by
+    tests/test_serving_piggyback.py — this row only prices it. The
+    metric value is the piggyback engine's aggregate tok/s."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import init_transformer
+    from deeplearning4j_tpu.serving import (
+        Request,
+        RequestScheduler,
+        ServingEngine,
+        ServingMetrics,
+        run_request_trace,
+    )
+
+    cfg, _, p = _decode_bench_cfg(args, batch=1, gqa=True,
+                                  prompt_len=long_len)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    sfx_len = 64
+    pfx_len = _DECODE_PROMPT_LEN - sfx_len
+    shared = rng.integers(0, p["vocab"], (pfx_len,)).astype(np.int32)
+    uniq = rng.integers(
+        0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
+    ).astype(np.int32)
+    longs = rng.integers(
+        0, p["vocab"], (n_long, long_len)).astype(np.int32)
+    # spread the long prompts through the middle of the trace so they
+    # land while short streams are actively decoding
+    long_at = set(
+        np.linspace(n_requests // 4, 3 * n_requests // 4, n_long)
+        .astype(int).tolist()
+    )
+
+    def make_trace():
+        reqs = []
+        for i in range(n_requests):
+            if i in long_at:
+                prompt = longs[len([j for j in long_at if j < i])]
+            elif i % 2 == 0:
+                prompt = np.concatenate([shared, uniq[i, :sfx_len]])
+            else:
+                prompt = uniq[i]
+            reqs.append(
+                (float(arrivals[i]),
+                 Request(prompt=prompt, max_new=_DECODE_NEW))
+            )
+        return reqs
+
+    def make_engine(pb):
+        return ServingEngine(
+            cfg, params, n_slots=n_slots,
+            max_total=long_len + _DECODE_NEW + 1,
+            temperature=1.0, top_k=40,
+            approx_top_k=not args.exact_top_k,
+            prefix_cache=True,
+            prefill_max_bucket=_DECODE_PROMPT_LEN,
+            piggyback=pb,
+            scheduler=RequestScheduler(max_queue_depth=n_requests),
+        )
+
+    def point(pb):
+        engine = make_engine(pb)
+        # warmup replay compiles this engine's programs (and the
+        # one-time parity probes), then metrics reset + timed run
+        run_request_trace(engine, make_trace())
+        if engine.prefix_cache is not None:
+            engine.prefix_cache.reinit()
+        engine.metrics = ServingMetrics()
+        engine.metrics.decode_horizon = engine.decode_horizon
+        trace = make_trace()
+        t0 = time.perf_counter()
+        results = run_request_trace(engine, trace)
+        dt = time.perf_counter() - t0
+        assert all(r.id in results for _, r in trace)
+        s = engine.metrics.summary()
+        return s["n_generated"] / dt, s, engine
+
+    on_tps, on_s, on_eng = point(True)
+    off_tps, off_s, _ = point(False)
+    tok_per_sec = on_tps
+    extra = {
+        "tpot_p99_s": round(on_s["tpot_p99_s"], 5),
+        "off_tpot_p99_s": round(off_s["tpot_p99_s"], 5),
+        "tpot_p99_ratio": round(
+            on_s["tpot_p99_s"] / max(off_s["tpot_p99_s"], 1e-9), 3),
+        "ttft_p50_s": round(on_s["ttft_p50_s"], 4),
+        "ttft_p99_s": round(on_s["ttft_p99_s"], 4),
+        "off_ttft_p50_s": round(off_s["ttft_p50_s"], 4),
+        "off_ttft_p99_s": round(off_s["ttft_p99_s"], 4),
+        "ttft_p99_ratio": round(
+            on_s["ttft_p99_s"] / max(off_s["ttft_p99_s"], 1e-9), 3),
+        "prefill_stall_s": round(on_s.get("decode_stall_s", 0.0), 4),
+        "off_prefill_stall_s": round(off_s.get("decode_stall_s", 0.0), 4),
+        "prefill_chunks": on_s.get("prefill_chunks", 0),
+        "prefill_budget_tokens": on_eng.prefill_budget,
+        "off_tok_per_sec": round(off_tps, 1),
+        "piggyback_armed": on_eng._piggyback,
+        "n_slots": n_slots,
+        "n_requests": n_requests,
+        "n_long_prompts": n_long,
+        "long_prompt_len": long_len,
+    }
+    metric = ("transformer_gpt2s_h128_decode_serve_piggyback_"
               "tokens_per_sec_per_chip")
     return tok_per_sec, metric, extra
 
@@ -2010,6 +2142,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-gqa-8kctx", "transformer-decode-gqa-8kctx-int8",
     "transformer-decode-serve", "transformer-decode-serve-faults",
     "transformer-decode-serve-prefix", "transformer-decode-serve-paged",
+    "transformer-decode-serve-piggyback",
     "transformer-decode-serve-tp", "transformer-decode-serve-router",
     "transformer-decode-serve-disagg",
     "transformer-decode-serve-tenant",
@@ -2038,6 +2171,7 @@ _AUTO_DTYPE = {
     "transformer-decode-serve-faults": "bf16",
     "transformer-decode-serve-prefix": "bf16",
     "transformer-decode-serve-paged": "bf16",
+    "transformer-decode-serve-piggyback": "bf16",
     "transformer-decode-serve-tp": "bf16",
     "transformer-decode-serve-router": "bf16",
     "transformer-decode-serve-disagg": "bf16",
@@ -2161,6 +2295,12 @@ def _run_one_inner(args, jax) -> None:
             _report(args, per_chip, metric, jax, extra=extra,
                     remeasure=lambda: (
                         _bench_decode_serve_paged(args)[0], None))
+            return
+        if args.model == "transformer-decode-serve-piggyback":
+            per_chip, metric, extra = _bench_decode_serve_piggyback(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (
+                        _bench_decode_serve_piggyback(args)[0], None))
             return
         if args.model == "transformer-decode-serve-tp":
             per_chip, metric, extra = _bench_decode_serve_tp(args)

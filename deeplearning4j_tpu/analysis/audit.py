@@ -342,6 +342,14 @@ def check_surface(cfg, geom, specs) -> list[AuditFinding]:
                 f"(bucket, group) grid {sorted(got)} != expected "
                 f"{sorted(exp[fam])}",
             ))
+    for fam in ("piggyback_step", "paged_piggyback_step"):
+        got = keyed(fam + r"\[b=(\d+),K=(\d+)\]")
+        if got != exp[fam]:
+            f.append(AuditFinding(
+                "surface", fam,
+                f"(bucket, K) grid {sorted(got)} != expected "
+                f"{sorted(exp[fam])}",
+            ))
     singles = {s.name for s in base if s.name in exp["singletons"]}
     missing = exp["singletons"] - singles
     if missing:

@@ -48,6 +48,7 @@ from deeplearning4j_tpu.serving.engine import (
     build_paged_prefill_program,
     build_paged_seg_fetch_program,
     build_paged_seg_import_program,
+    build_piggyback_program,
     build_prefill_program,
     build_replay_program,
     build_seg_fetch_program,
@@ -322,6 +323,28 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
                 ),
                 n_substeps=1,
             )
+    if want("piggyback_step"):
+        # fused chunk+decode piggyback: the pow2 chunk grid crossed
+        # with the step horizons — ascending, so the last entry per
+        # family is the (max bucket, max K) budget envelope. One
+        # chunk leg (unscanned forward_chunk pass) costs the same
+        # collective count as one decode substep, hence K+1.
+        for b in geom.buckets(cfg):
+            for k in geom.horizons():
+                add(
+                    f"piggyback_step[b={b},K={k}]", "piggyback_step",
+                    lambda b=b, k=k: (
+                        build_piggyback_program(
+                            av.fwd1, av.fwd_chunk, k,
+                            geom.temperature, geom.top_k,
+                            geom.approx_top_k,
+                        ),
+                        (av.params, *av.state(), av.slot_keys,
+                         av.adapters, av.scratch, _i32(1, b),
+                         _i32(), _i32(), _i32(1)),
+                    ),
+                    n_substeps=k + 1,
+                )
     if want("insert"):
         add(
             "insert", "insert",
@@ -380,6 +403,24 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
                 ),
                 n_substeps=k,
             )
+    if geom.paged and want("paged_piggyback_step"):
+        for b in geom.buckets(cfg):
+            for k in geom.horizons():
+                add(
+                    f"paged_piggyback_step[b={b},K={k}]",
+                    "paged_piggyback_step",
+                    lambda b=b, k=k: (
+                        build_piggyback_program(
+                            make_paged_fwd1(av.fwd1), av.fwd_chunk,
+                            k, geom.temperature, geom.top_k,
+                            geom.approx_top_k,
+                        ),
+                        (av.params, *av.paged_state(), av.slot_keys,
+                         av.adapters, av.scratch, _i32(1, b),
+                         _i32(), _i32(), _i32(1)),
+                    ),
+                    n_substeps=k + 1,
+                )
     if geom.paged and want("paged_replay"):
         add(
             "paged_replay", "paged_replay",
@@ -473,7 +514,8 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
 
 #: forward-pass families — the ones whose TP variants carry the
 #: collective contract (the copy/slice programs contain no model code)
-_FORWARD_FAMILIES = {"step", "replay", "prefill", "chunk"}
+_FORWARD_FAMILIES = {"step", "replay", "prefill", "chunk",
+                     "piggyback_step"}
 
 
 def enumerate_programs(
@@ -499,7 +541,8 @@ def enumerate_programs(
         if geom.paged:
             # TP paged serving exists (paged-parity TP tests), so its
             # forward variants carry the same collective contract
-            fams |= {"paged_step", "paged_replay", "paged_prefill"}
+            fams |= {"paged_step", "paged_replay", "paged_prefill",
+                     "paged_piggyback_step"}
         specs += _specs_for(
             _FamilyAvals(cfg_tp, geom, tp_mesh=mesh), geom,
             tp=True, suffix=f"[tp={geom.tp}]",
@@ -549,6 +592,16 @@ def expected_surface(
         "paged_prefill": buckets if geom.paged else set(),
         "batch_prefill": {(b, n) for b in buckets for n in groups},
         "batch_hit": {(b, n) for b in buckets for n in groups},
+        # piggyback: the pow2 chunk grid crossed with the step
+        # horizons — the fused-program surface is bounded by
+        # O(log max_bucket) x |{1, K}|
+        "piggyback_step": {
+            (b, k) for b in buckets for k in geom.horizons()
+        },
+        "paged_piggyback_step": (
+            {(b, k) for b in buckets for k in geom.horizons()}
+            if geom.paged else set()
+        ),
         "singletons": singletons,
         "log_bound": int(math.log2(mb)) + 1,
     }
@@ -580,8 +633,10 @@ def live_engine_families(engine) -> dict[str, set]:
         if fn is not None:
             singles.add(name)
     # a paged engine's step-fn cache holds paged_step programs (same
-    # horizon keys, paged fwd1) — report it under the paged family
+    # horizon keys, paged fwd1) — report it under the paged family;
+    # same for the fused piggyback cache, keyed (bucket, K)
     steps = set(engine._step_fns)
+    pb = set(getattr(engine, "_piggyback_fns", {}))
     return {
         "step": set() if paged else steps,
         "paged_step": steps if paged else set(),
@@ -590,6 +645,8 @@ def live_engine_families(engine) -> dict[str, set]:
         "chunk": set(engine._chunk_fns),
         "batch_prefill": set(engine._batch_prefill_fns),
         "batch_hit": set(engine._batch_hit_fns),
+        "piggyback_step": set() if paged else pb,
+        "paged_piggyback_step": pb if paged else set(),
         "singletons": singles,
     }
 

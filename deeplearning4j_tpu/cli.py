@@ -539,6 +539,8 @@ def cmd_serve(args) -> int:
         prefix_cache_tokens=args.prefix_cache_tokens,
         paged=args.paged,
         block_size=args.block_size,
+        piggyback=args.piggyback,
+        prefill_budget=args.prefill_budget,
         scheduler=RequestScheduler(
             max_queue_depth=args.max_queue,
             prefix_affinity_tokens=args.prefix_affinity_tokens,
@@ -570,6 +572,14 @@ def cmd_serve(args) -> int:
             print("paged KV DISABLED (parity probe failed or block "
                   "size does not divide tokens/slot); slab slots",
                   file=sys.stderr)
+    if args.piggyback:
+        if engine._piggyback:
+            print(f"piggyback prefill: chunked admission fused into "
+                  f"decode dispatches ({engine.prefill_budget} "
+                  f"tokens/horizon budget)")
+        else:
+            print("piggyback prefill DISABLED (parity probe failed); "
+                  "blocking admission prefill", file=sys.stderr)
     if args.tp > 1:
         if engine.tp == args.tp:
             print(f"tensor parallel: decode sharded over {engine.tp} "
@@ -1162,6 +1172,17 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("--block-size", type=int, default=None, metavar="T",
                    help="tokens per KV block with --paged (default: "
                    "engine picks; must divide tokens-per-slot)")
+    v.add_argument("--piggyback", action="store_true",
+                   help="chunked-prefill piggyback: long prompts are "
+                   "split into pow2 chunks and ride along with decode "
+                   "dispatches (one fused program per horizon) instead "
+                   "of stalling active streams behind a blocking "
+                   "prefill. Token-budgeted per horizon; byte-identical "
+                   "streams, gated by a one-time parity probe")
+    v.add_argument("--prefill-budget", type=int, default=None,
+                   metavar="N",
+                   help="piggyback prefill token budget per decode "
+                   "horizon (default: 2x the largest prefill bucket)")
     v.add_argument("--prefix-affinity-tokens", type=int, default=0,
                    metavar="K",
                    help="scheduler promotes a queued request whose "

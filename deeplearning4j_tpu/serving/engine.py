@@ -121,6 +121,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -221,6 +222,11 @@ PROGRAM_DONATION: dict[str, tuple[int, ...]] = {
     "block_copy": (0,),
     "paged_seg_fetch": (),
     "paged_seg_import": (0,),
+    # piggyback: decode state donated exactly as "step" (argnums
+    # 1..5), plus the admitting slot's chunk scratch slab (argnum 9),
+    # consumed by the fused program's updated-scratch output
+    "piggyback_step": (1, 2, 3, 4, 5, 9),
+    "paged_piggyback_step": (1, 2, 3, 4, 5, 9),
 }
 
 
@@ -356,6 +362,54 @@ def build_chunk_program(fwd_chunk):
         return tmp, lg
 
     return chunk
+
+
+def build_piggyback_program(fwd1, fwd_chunk, horizon: int,
+                            temperature: float, top_k: int | None,
+                            approx_top_k: bool):
+    """Chunked-prefill piggyback (Sarathi-style): K fused decode
+    substeps for the active slots AND one bounded prefill chunk for an
+    admitting slot, in a single dispatch. The decode leg is the
+    ``build_step_program`` body verbatim; the chunk leg is the
+    ``build_chunk_program`` body verbatim, over the admitting slot's
+    OWN batch-1 scratch cache — the two legs share no buffers, so
+    fusing them cannot perturb either side's numerics (the
+    construction-time piggyback parity probe proves it bitwise)."""
+
+    def pstep(params, caches, logits, pos, active, budget, eos,
+              slot_keys_raw, adapters, tmp, ctoks, cpos0, clast,
+              cadapter):
+        keys = (
+            jax.random.wrap_key_data(slot_keys_raw)
+            if temperature != 0 else None
+        )
+        toks_all = []
+        for k in range(horizon):
+            filt = _top_k_filter(logits, top_k, approx_top_k)
+            if temperature == 0:
+                toks = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+            else:
+                tok_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+                toks = jax.vmap(
+                    lambda kk, lg: jax.random.categorical(kk, lg)
+                )(tok_keys, filt / temperature).astype(jnp.int32)
+            toks = jnp.where(active, toks, 0)
+            new_logits, caches = fwd1(
+                params, caches, toks, pos, adapter=adapters
+            )
+            pos = jnp.where(active, pos + 1, pos)
+            budget = jnp.where(active, budget - 1, budget)
+            active = active & (toks != eos) & (budget > 0)
+            logits = new_logits
+            toks_all.append(toks)
+        clg, tmp = fwd_chunk(
+            params, tmp, ctoks, cpos0, last_idx=clast,
+            adapter=cadapter,
+        )
+        return (caches, logits, pos, active, budget,
+                jnp.stack(toks_all, axis=1), tmp, clg)
+
+    return pstep
 
 
 def build_insert_program():
@@ -700,6 +754,24 @@ class _AdmitPlan:
         self.t_pf = 0.0
 
 
+class _PendingPrefill:
+    """One deferred admission (chunked-prefill piggyback): the plan
+    holds the acquired slot + pinned prefix segment; ``chunks`` is the
+    remaining pow2 chunk schedule over the uncached suffix; ``tmp`` /
+    ``lg`` carry the batch-1 scratch cache and last chunk's (1, V)
+    logits across horizons until the completion insert seats the
+    slot."""
+
+    __slots__ = ("plan", "chunks", "tmp", "lg", "t_start")
+
+    def __init__(self, plan: _AdmitPlan, chunks, tmp, t_start: float):
+        self.plan = plan
+        self.chunks = chunks
+        self.tmp = tmp
+        self.lg = None
+        self.t_start = t_start
+
+
 # Process-level compiled-program sharing.  The callable a family jits
 # is fully determined by (cfg, tp, paged geometry, max_total, the
 # family's own statics): two engines with the same key — replica
@@ -811,6 +883,9 @@ class ServingEngine:
         paged: bool = False,
         block_size: int | None = None,
         paged_parity: bool | str = "auto",
+        piggyback: bool = False,
+        prefill_budget: int | None = None,
+        piggyback_parity: bool | str = "auto",
     ):
         self.n_slots = n_slots
         self.max_total = int(min(max_total or cfg.max_len, cfg.max_len))
@@ -1052,6 +1127,26 @@ class ServingEngine:
             else self._min_bucket
         )
 
+        # chunked-prefill piggyback (Sarathi-style): long-prompt
+        # admissions defer their uncached suffix to a FIFO of pending
+        # records that the dispatch loop drains under a per-horizon
+        # token budget, fusing the last budgeted chunk into the decode
+        # dispatch itself. Default budget 2x the largest bucket: one
+        # standalone chunk + one fused chunk per horizon, so a
+        # deferred prompt always makes >= _max_bucket progress while
+        # decode keeps stepping. The path arms only after the
+        # construction-time parity probe below proves the fused
+        # program bitwise-identical to step + chunk run separately.
+        self._piggyback_requested = bool(piggyback)
+        self._piggyback = False
+        self.prefill_budget = max(1, int(
+            prefill_budget if prefill_budget is not None
+            else 2 * self._max_bucket
+        ))
+        self._pending_prefills: deque[_PendingPrefill] = deque()
+        self._presplit_keys: dict[str, np.ndarray] = {}
+        self._pb_did_work = False
+
         # prefix cache: radix tree over a bounded segment region with
         # the pool's slab layout (see serving.prefix_cache). Partial
         # hits are rounded DOWN to the bucket grain (_min_bucket) so
@@ -1191,6 +1286,34 @@ class ServingEngine:
         self._paged_seg_import_fn = None
         self._block_copy_fn = None
         self._paged_admit_donate = self._donate("paged_prefill")
+        # chunked-prefill piggyback: one fused program per (bucket, K)
+        # actually used, gated by a construction-time bitwise parity
+        # probe (ProbeCache'd) — probe failure falls back to blocking
+        # admission prefill, never to wrong bytes
+        self._piggyback_fns: dict[tuple[int, int], object] = {}
+        if self._piggyback_requested and piggyback_parity is not False:
+            ok = (
+                True if piggyback_parity is True
+                else self._probe_verdict(
+                    "piggyback_parity",
+                    self._probe_piggyback_parity,
+                    n_slots=self.n_slots,
+                    max_total=self.max_total,
+                    max_bucket=self._max_bucket,
+                    tp=self.tp,
+                    paged=self._paged,
+                    temperature=self.temperature,
+                    top_k=self.top_k,
+                    horizon=self.decode_horizon,
+                )
+            )
+            if ok:
+                self._piggyback = True
+            else:
+                log_event(
+                    _log, "piggyback_parity_probe_failed",
+                    fallback="blocking admission prefill",
+                )
         # arm attribution last: everything dispatched above was a probe
         self._attr_enabled = bool(attribution)
 
@@ -1300,6 +1423,17 @@ class ServingEngine:
             "(shrinks to 1 under adaptive_horizon while the queue is "
             "non-empty).",
         ).set_function(lambda: self.decode_horizon_current)
+        if self._piggyback_requested:
+            reg.gauge(
+                "serve_prefill_budget_tokens",
+                "Chunk tokens the piggyback scheduler may spend per "
+                "decode horizon (--prefill-budget).",
+            ).set_function(lambda: self.prefill_budget)
+            reg.gauge(
+                "serve_prefill_pending",
+                "Admissions whose prefill is deferred across horizons "
+                "(piggyback records holding a slot, not yet seated).",
+            ).set_function(lambda: len(self._pending_prefills))
         if self.prefix_cache is not None:
             reg.gauge(
                 "serve_prefix_segments", "Cached prefix segments.",
@@ -1391,6 +1525,35 @@ class ServingEngine:
                 lambda: jax.jit(build_chunk_program(self._fwd_chunk)),
             )
             self._chunk_fns[bucket] = fn
+        return fn
+
+    def _piggyback_fn(self, bucket: int, horizon: int):
+        """Jitted fused chunk+decode piggyback program (see
+        :func:`build_piggyback_program`). Like ``_chunk_fn``, one
+        callable serves every bucket (jit retraces per chunk aval);
+        the per-(bucket, K) dict keys express the compile surface the
+        audit fences."""
+        fn = self._piggyback_fns.get((bucket, horizon))
+        if fn is None:
+            fn = _shared_program(
+                self._prog_key + (
+                    "piggyback_step", horizon, self.temperature,
+                    self.top_k, self.approx_top_k,
+                ),
+                lambda: jax.jit(
+                    build_piggyback_program(
+                        make_paged_fwd1(self._fwd1) if self._paged
+                        else self._fwd1,
+                        self._fwd_chunk, horizon, self.temperature,
+                        self.top_k, self.approx_top_k,
+                    ),
+                    donate_argnums=self._donate(
+                        "paged_piggyback_step" if self._paged
+                        else "piggyback_step"
+                    ),
+                ),
+            )
+            self._piggyback_fns[(bucket, horizon)] = fn
         return fn
 
     def _insert(self):
@@ -1702,6 +1865,10 @@ class ServingEngine:
             if st is not None and not st.req.cancelled:
                 st.req.cancel()
                 n += 1
+        for rec in self._pending_prefills:
+            if not rec.plan.req.cancelled:
+                rec.plan.req.cancel()
+                n += 1
         return n + self.scheduler.cancel_all()
 
     # -- live session migration --------------------------------------------
@@ -1938,6 +2105,7 @@ class ServingEngine:
     def _retire_unadmitted(self, req: Request, status: RequestStatus,
                            error: str | None = None) -> None:
         """Terminal status for a request that never got a slot."""
+        self._presplit_keys.pop(req.id, None)
         req.status = status
         req.error = error
         self.metrics.record_outcome(status, tenant=req.tenant_id)
@@ -2380,6 +2548,38 @@ class ServingEngine:
             elif st.req.expired(now):
                 self._retire(slot, RequestStatus.EXPIRED, now,
                              deactivate=True)
+        # piggyback records hold a slot before seating — sweep them on
+        # the same cadence so a cancelled/expired deferred admission
+        # frees its slot (and pinned segment) within one horizon too
+        if self._pending_prefills:
+            kept: deque[_PendingPrefill] = deque()
+            while self._pending_prefills:
+                rec = self._pending_prefills.popleft()
+                req = rec.plan.req
+                if req.cancelled or req.expired(now):
+                    self._drop_pending(
+                        rec,
+                        RequestStatus.CANCELLED if req.cancelled
+                        else RequestStatus.EXPIRED,
+                    )
+                else:
+                    kept.append(rec)
+            self._pending_prefills = kept
+
+    def _drop_pending(self, rec: _PendingPrefill,
+                      status: RequestStatus,
+                      error: str | None = None) -> None:
+        """Release a deferred admission's slot + pinned segment and
+        retire its request without seating. Executed chunks stay
+        charged to the tenant's DRR deficit (the device time was
+        spent); the un-executed remainder was already credited back at
+        defer time."""
+        pl = rec.plan
+        if pl.seg is not None and self.prefix_cache is not None:
+            self.prefix_cache.unpin(pl.seg)
+            pl.seg = None
+        self.pool.release(pl.slot)
+        self._retire_unadmitted(pl.req, status, error)
 
     # -- admission ---------------------------------------------------------
 
@@ -2608,6 +2808,84 @@ class ServingEngine:
                 np.array_equal(lg_a, lg_c)
                 and all(np.array_equal(a, c)
                         for a, c in zip(rows_a, rows_c))
+            )
+        finally:
+            self.prefill_dispatches = _disp
+            self._attr_suspend -= 1
+
+    def _probe_piggyback_parity(self) -> bool:
+        """One-time probe gating the piggyback path: does the FUSED
+        chunk+decode program reproduce, bitwise, what the production
+        step program and chunk program produce when run separately
+        over identical inputs — every decode-state leaf, the sampled
+        token matrix, the scratch slab, and the chunk logits row? The
+        legs share no buffers, so this holds by construction unless
+        the backend schedules the fused graph differently; when it
+        does not hold bitwise, piggyback stays off and admission
+        prefill keeps blocking (slow, never wrong)."""
+        b = self._max_bucket
+        k = self.decode_horizon
+        n = self.n_slots
+        vs = self.cfg.vocab_size
+        _disp = self.prefill_dispatches  # probes don't count
+        self._attr_suspend += 1  # nor toward device-time attribution
+        try:
+            def caches0():
+                if self._paged:
+                    # sentinel-only tables: same avals as the live
+                    # operand (no new compile surface), every row
+                    # scatters to block 0 identically on both sides
+                    return {
+                        "blocks": jax.tree.map(
+                            jnp.zeros_like, self.pool.caches
+                        ),
+                        "tables": jnp.zeros(
+                            (n, self.pool.blocks_per_slot), jnp.int32
+                        ),
+                    }
+                return self._init_caches(n, self.max_total)
+
+            def decode_state():
+                # donation safety: each side gets fresh buffers
+                lg = (
+                    jnp.arange(n * vs, dtype=jnp.float32)
+                    .reshape(n, vs) % 7.0
+                )
+                return (
+                    caches0(), lg,
+                    jnp.arange(n, dtype=jnp.int32) % 3,
+                    jnp.ones((n,), bool),
+                    jnp.full((n,), 5, jnp.int32),
+                    jnp.full((n,), _NO_EOS, jnp.int32),
+                )
+
+            keys = np.arange(
+                self._slot_keys.size, dtype=self._slot_keys.dtype
+            ).reshape(self._slot_keys.shape)
+            ad = jnp.zeros((n,), jnp.int32)
+            ctoks = jnp.asarray(
+                ((1 + np.arange(b)) % vs).astype(np.int32)[None, :]
+            )
+            cad = jnp.zeros((1,), jnp.int32)
+            # separate: the production step + chunk programs
+            out_a = self._step_fn_for(k)(
+                self.params, *decode_state(), jnp.asarray(keys), ad
+            )
+            tmp_a, lg_a = self._chunk_fn(b)(
+                self.params, self._init_caches(1, self.max_total),
+                ctoks, jnp.int32(0), jnp.int32(b - 1), cad,
+            )
+            # fused: one piggyback dispatch over identical inputs
+            out_b = self._piggyback_fn(b, k)(
+                self.params, *decode_state(), jnp.asarray(keys), ad,
+                self._init_caches(1, self.max_total), ctoks,
+                jnp.int32(0), jnp.int32(b - 1), cad,
+            )
+            return bool(
+                self._states_equal(out_a, out_b[:6])
+                and self._states_equal(tmp_a, out_b[6])
+                and np.array_equal(np.asarray(lg_a),
+                                   np.asarray(out_b[7]))
             )
         finally:
             self.prefill_dispatches = _disp
@@ -3277,8 +3555,13 @@ class ServingEngine:
         sampling key split (in admission order — the order replay
         reproduces), slot state, metrics, spans."""
         req, slot = pl.req, pl.slot
-        self._key, sub = jax.random.split(self._key)
-        kd = np.asarray(jax.random.key_data(sub))  # lint: sync-ok per-admission key snapshot (tiny, off the decode critical section)
+        # piggyback engines pre-split at plan execution (same order)
+        # so a prefill deferred across horizons cannot reorder the
+        # master key chain; everyone else splits here, at seating
+        kd = self._presplit_keys.pop(req.id, None)
+        if kd is None:
+            self._key, sub = jax.random.split(self._key)
+            kd = np.asarray(jax.random.key_data(sub))  # lint: sync-ok per-admission key snapshot (tiny, off the decode critical section)
         self._slot_keys[slot] = kd
         self._slot_adapters[slot] = req.adapter
         st = _SlotState(req, self.pool.generation(slot), kd,
@@ -3537,6 +3820,32 @@ class ServingEngine:
                 self._retire_unadmitted(
                     pl.req, RequestStatus.FAILED, pl.req.error
                 )
+        deferred: set[int] = set()
+        if self._piggyback:
+            # pre-split sampling keys for EVERY surviving plan now, in
+            # admission order — the exact split sequence non-piggyback
+            # seating produces — so deferring a prefill across
+            # horizons cannot reorder the master key chain (sampled
+            # byte parity). A crash before seating keeps the stash;
+            # re-admission reuses it without advancing the chain,
+            # matching the blocking path (which never split either).
+            for pl in live:
+                if pl.req.id not in self._presplit_keys:
+                    self._key, sub = jax.random.split(self._key)
+                    self._presplit_keys[pl.req.id] = np.asarray(
+                        jax.random.key_data(sub)
+                    )  # lint: sync-ok per-admission key snapshot (tiny, off the decode critical section)
+            # defer only prompts whose uncached suffix exceeds one
+            # bucket — everything the blocking path serves in a single
+            # prefill dispatch stays on the blocking path, bitwise
+            for pl in live:
+                n = len(pl.req.prompt)
+                cached = pl.matched if pl.kind == "partial" else 0
+                if pl.kind != "full" and n - cached > self._max_bucket:
+                    self._enqueue_piggyback(pl, now)
+                    deferred.add(id(pl))
+        occupied = any(st is not None for st in self._slots)
+        t_exec = time.perf_counter()
         # group what can share a dispatch
         batch_ok = len(live) > 1 and self._batch_admission_ok()
         miss_groups: dict[int, list[_AdmitPlan]] = {}
@@ -3575,7 +3884,7 @@ class ServingEngine:
                     batched.add(id(pl))
         # serial remainder, in admission order
         for pl in live:
-            if id(pl) in batched:
+            if id(pl) in batched or id(pl) in deferred:
                 continue
             t0 = time.perf_counter()
             if pl.kind == "full":
@@ -3590,12 +3899,188 @@ class ServingEngine:
                     adapter=pl.req.adapter,
                 )
             pl.t_pf, pl.prefill_s = t0, time.perf_counter() - t0
+        # decode-stall accounting: admission prefill executed while
+        # decode slots sat occupied is exactly the stall piggyback
+        # exists to bound — measured identically on and off so the
+        # bench comparison is honest
+        if occupied:
+            self.metrics.record_decode_stall(
+                time.perf_counter() - t_exec
+            )
         # seat states in admission order (sampling-key split order is
         # part of the determinism contract), then cache new prefixes
         for pl in live:
-            self._seat_plan(pl, now)
+            if id(pl) not in deferred:
+                self._seat_plan(pl, now)
         for pl in live:
-            self._maybe_insert_prefix(pl)
+            if id(pl) not in deferred:
+                self._maybe_insert_prefix(pl)
+
+    # -- chunked-prefill piggyback -----------------------------------------
+    #
+    # A deferred admission keeps its acquired slot and pinned prefix
+    # segment but is NOT seated: its uncached suffix sits as a pow2
+    # chunk schedule in a _PendingPrefill record, and every dispatch
+    # horizon spends up to `prefill_budget` chunk tokens advancing the
+    # FIFO — middles standalone, the last budgeted chunk FUSED into
+    # the decode dispatch itself (the piggyback_step program). The
+    # final chunk always runs standalone so the completion insert
+    # consumes a well-defined logits row, then the record completes —
+    # insert + seat — in the same horizon a blocking admission would
+    # have joined. Byte parity with the blocking path holds because
+    # the chunk programs, schedule, and scratch slab are IDENTICAL;
+    # only the horizon at which each dispatch happens moves.
+
+    def _enqueue_piggyback(self, pl: _AdmitPlan, now: float) -> None:
+        """Turn an executed-plan candidate into a pending record: set
+        up its scratch slab (segment fetch for partial hits — only the
+        uncached suffix is piggybacked), its chunk schedule, and, in
+        paged mode, its private block coverage."""
+        req = pl.req
+        L = pl.matched if pl.kind == "partial" else 0
+        if self._paged:
+            # private blocks for every row the slot will write; rows
+            # [0, L) stay sentinel-mapped until completion (decode
+            # steps run while this record is pending, and an inactive
+            # slot's frozen-position garbage write must never land in
+            # a SHARED prefix block — aliasing is deferred to
+            # _complete_pending, a refcount bump that cannot fail)
+            full = L // self.pool.block_size
+            self.pool.alloc_slot_blocks(
+                pl.slot, min(len(req.prompt) + req.max_new,
+                             self.pool.tpad),
+                start=full,
+            )
+            tmp = (self._paged_seg_tmp(pl.seg) if pl.kind == "partial"
+                   else self._init_caches(1, self.max_total))
+        elif pl.kind == "partial":
+            tmp = self._seg_fetch()(
+                self.prefix_cache.region, jnp.int32(pl.seg.slot)
+            )
+        else:
+            tmp = self._init_caches(1, self.max_total)
+        rec = _PendingPrefill(
+            pl, deque(self._chunk_schedule(len(req.prompt), start=L)),
+            tmp, now,
+        )
+        self._pending_prefills.append(rec)
+        # the scheduler pop charged the whole prompt to the tenant's
+        # DRR deficit up front; credit the deferred suffix back here
+        # and re-charge it chunk by chunk as the work executes, so
+        # fairness meters the device time when it is actually spent
+        self.scheduler.adjust_deficit(req, float(len(req.prompt) - L))
+        self.flight.record(
+            "piggyback", phase="defer", req_id=req.id, slot=pl.slot,
+            suffix_tokens=len(req.prompt) - L,
+            n_chunks=len(rec.chunks),
+        )
+        self.tracer.instant(
+            slot_track(pl.slot), "piggyback_defer", req_id=req.id,
+            suffix_tokens=len(req.prompt) - L,
+        )
+        log_event(_log, "piggyback_defer", req_id=req.id, slot=pl.slot,
+                  prompt_len=len(req.prompt), cached_tokens=L,
+                  n_chunks=len(rec.chunks),
+                  tenant=req.tenant_id or None)
+
+    def _account_chunk(self, rec: _PendingPrefill, ln: int,
+                       fused: bool) -> None:
+        """Bookkeeping for one executed piggyback chunk (standalone or
+        fused): pop it from the schedule, charge the tenant, count."""
+        rec.chunks.popleft()
+        pl = rec.plan
+        self.prefill_dispatches += 1
+        self.metrics.record_prefill_chunk(ln)
+        self.scheduler.adjust_deficit(pl.req, -float(ln))
+        self.flight.record(
+            "piggyback", phase="chunk", req_id=pl.req.id, slot=pl.slot,
+            chunk_tokens=ln, fused=fused, remaining=len(rec.chunks),
+        )
+
+    def _run_pending_chunk(self, rec: _PendingPrefill) -> int:
+        """Run the head chunk of ``rec`` standalone — the non-fused
+        path: budget middles, final chunks, and horizons with no
+        active decode slot to piggyback on. Returns real tokens."""
+        pl = rec.plan
+        t0, ln, b = rec.chunks[0]
+        pad = np.zeros((1, b), np.int32)
+        pad[0, :ln] = pl.req.prompt[t0:t0 + ln]
+        self._attr("chunk")
+        rec.tmp, rec.lg = self._chunk_fn(b)(
+            self.params, rec.tmp, jnp.asarray(pad), jnp.int32(t0),
+            jnp.int32(ln - 1), jnp.asarray([pl.req.adapter], jnp.int32),
+        )
+        self._account_chunk(rec, ln, fused=False)
+        return ln
+
+    def _complete_pending(self, rec: _PendingPrefill) -> None:
+        """All chunks executed: land the scratch slab with the SAME
+        insert program the blocking path uses, seat the slot, and
+        cache the new prefix — the deferred admission is now
+        indistinguishable from a blocking one."""
+        pl = rec.plan
+        req = pl.req
+        now = time.perf_counter()
+        n = len(req.prompt)
+        eos_tok = _NO_EOS if req.eos_token is None else int(req.eos_token)
+        if self._paged and pl.kind == "partial":
+            # alias the cached prefix blocks in now (refcount bump,
+            # no allocation — the private coverage was reserved at
+            # defer time); the insert scatter then rewrites the
+            # aliased rows with the identical bytes the segment holds,
+            # exactly like the blocking partial-hit path
+            full = pl.matched // self.pool.block_size
+            if full:
+                self.pool.alias_into_slot(
+                    pl.slot, pl.seg.block_ids[:full]
+                )
+        insert = self._paged_insert() if self._paged else self._insert()
+        self._set_state(insert(
+            *self._state(), rec.tmp, rec.lg, jnp.int32(pl.slot),
+            jnp.int32(n), jnp.int32(req.max_new), jnp.int32(eos_tok),
+        ))
+        pl.t_pf = rec.t_start
+        pl.prefill_s = now - rec.t_start
+        self._seat_plan(pl, now)
+        self._maybe_insert_prefix(pl)
+        self.flight.record(
+            "piggyback", phase="seated", req_id=req.id, slot=pl.slot,
+            prefill_s=round(pl.prefill_s, 6),
+        )
+
+    def _advance_piggyback(self, can_fuse: bool
+                           ) -> _PendingPrefill | None:
+        """Spend up to ``prefill_budget`` chunk tokens advancing the
+        pending FIFO (oldest first — Sarathi-style per-iteration token
+        budget). Returns the record whose head chunk should be FUSED
+        into this horizon's decode dispatch (never a record's final
+        chunk), or None. The first chunk always runs even over budget,
+        so every pending admission makes progress each horizon."""
+        budget = self.prefill_budget
+        spent = 0
+        fused = None
+        t_wall = time.perf_counter()
+        while self._pending_prefills and spent < budget:
+            rec = self._pending_prefills[0]
+            ln = rec.chunks[0][1]
+            final = len(rec.chunks) == 1
+            if not final and can_fuse and spent + ln >= budget:
+                fused = rec
+                self._pb_did_work = True
+                break
+            spent += self._run_pending_chunk(rec)
+            self._pb_did_work = True
+            if final:
+                self._complete_pending(rec)
+                self._pending_prefills.popleft()
+        if can_fuse and spent:
+            # standalone chunks executed ahead of an occupied-slot
+            # dispatch are residual decode stall (the fused chunk is
+            # the part that isn't)
+            self.metrics.record_decode_stall(
+                time.perf_counter() - t_wall
+            )
+        return fused
 
     # -- supervised dispatch + pipelined readback --------------------------
 
@@ -3607,17 +4092,38 @@ class ServingEngine:
         implicated request when one is named, otherwise escalate to
         ``EngineCrash`` (replay recovery). Returns None when there is
         nothing to dispatch (or quarantining emptied the batch)."""
+        self._pb_did_work = False
+        fused = None
+        if self._pending_prefills:
+            # advance deferred prefills under the token budget FIRST:
+            # completions seat their slot pre-dispatch (joining this
+            # horizon exactly as a blocking admission would), and the
+            # returned record's head chunk rides the decode dispatch
+            # below. With no occupied slot there is nothing to fuse
+            # with — chunks run standalone and this horizon may
+            # dispatch no step at all.
+            fused = self._advance_piggyback(  # lint: sync-ok host-int chunk accounting, no device readback
+                can_fuse=any(st is not None for st in self._slots)
+            )
         if not any(st is not None for st in self._slots):
             return None
         # adaptive horizon: when requests are waiting for a slot, drop
         # to K=1 so the next admission boundary arrives one substep
         # away; restore the configured K once the queue drains. Byte-
         # safe — the device stopping rule is applied per-substep, so
-        # the emitted stream is invariant to K.
+        # the emitted stream is invariant to K. Piggyback pendings are
+        # NOT queue pressure (their slot is already taken): K stays
+        # configured, the budget bounds their prefill instead.
         k = (1 if (self.adaptive_horizon and len(self.scheduler) > 0)
              else self.decode_horizon)
         self.decode_horizon_current = k
         step_fn = self._step_fn_for(k)
+        if fused is not None:
+            fp = fused.plan
+            ct0, cln, cb = fused.chunks[0]
+            cpad = np.zeros((1, cb), np.int32)
+            cpad[0, :cln] = fp.req.prompt[ct0:ct0 + cln]
+            pb_fn = self._piggyback_fn(cb, k)
         attempt, backoff = 0, self.retry_backoff_s
         t_call = time.perf_counter()
         # .copy(): jnp.asarray can zero-copy alias the mutable host key
@@ -3635,14 +4141,31 @@ class ServingEngine:
                 # retire below releases the slot and rewrites its table
                 # row, so the paged table mirror must be rebuilt before
                 # every (re)dispatch
-                (caches, self._logits, self._dpos,
-                 self._dactive, self._dbudget, toks) = step_fn(
-                    self.params, self._caches_in(), self._logits,
-                    self._dpos, self._dactive, self._dbudget,
-                    self._deos, jnp.asarray(keys_host),
-                    jnp.asarray(ad_host),
-                )
+                if fused is None:
+                    (caches, self._logits, self._dpos,
+                     self._dactive, self._dbudget, toks) = step_fn(
+                        self.params, self._caches_in(), self._logits,
+                        self._dpos, self._dactive, self._dbudget,
+                        self._deos, jnp.asarray(keys_host),
+                        jnp.asarray(ad_host),
+                    )
+                else:
+                    # piggyback: K decode substeps + one bounded
+                    # prefill chunk for the admitting slot, fused
+                    (caches, self._logits, self._dpos,
+                     self._dactive, self._dbudget, toks,
+                     fused.tmp, fused.lg) = pb_fn(
+                        self.params, self._caches_in(), self._logits,
+                        self._dpos, self._dactive, self._dbudget,
+                        self._deos, jnp.asarray(keys_host),
+                        jnp.asarray(ad_host), fused.tmp,
+                        jnp.asarray(cpad), jnp.int32(ct0),
+                        jnp.int32(cln - 1),
+                        jnp.asarray([fp.req.adapter], jnp.int32),
+                    )
                 self._caches_out(caches)
+                if fused is not None:
+                    self._account_chunk(fused, cln, fused=True)  # lint: sync-ok host-int chunk accounting
                 break
             except TransientFault as e:
                 self.metrics.record_retry()
@@ -3701,11 +4224,14 @@ class ServingEngine:
             ENGINE_TRACK, "dispatch", t_call, now - t_call,
             n_active=len(snaps),
         )
-        self._attr("paged_step" if self._paged else "step", t_call)
+        fam = "step" if fused is None else "piggyback_step"
+        self._attr(("paged_" + fam) if self._paged else fam, t_call)
         if self.flight.enabled:
             self.flight.record(
                 "dispatch", k=k, n_active=len(snaps),
                 queue_depth=len(self.scheduler),
+                **({"piggyback_chunk": cln} if fused is not None
+                   else {}),
                 **({"blocks_in_use": self.pool.n_blocks_in_use,
                     "blocks_free": self.pool.n_free_blocks}
                    if self._paged else {}),
@@ -3820,7 +4346,8 @@ class ServingEngine:
             self._set_phase(None)
             if prof is not None:
                 prof.step_end()
-        progressed = prev is not None or self._inflight is not None
+        progressed = (prev is not None or self._inflight is not None
+                      or self._pb_did_work)
         if self.tracer.enabled and progressed:
             t_end = time.perf_counter()
             self.tracer.span(
@@ -3953,6 +4480,22 @@ class ServingEngine:
 
     def _recover_inner(self, t_rec: float) -> int:
         self._inflight = None
+        # deferred admissions lose their device-side chunk progress
+        # with the abandoned buffers: hand them back to the scheduler
+        # (reversed, so front-requeue restores admission order) before
+        # the pool reinit and replay only seated slots. Their
+        # pre-split sampling keys stay stashed — re-admission reuses
+        # them without advancing the master chain, exactly the key an
+        # uninterrupted blocking run would have assigned.
+        if self._pending_prefills:
+            for rec in reversed(self._pending_prefills):
+                pl = rec.plan
+                if pl.seg is not None and self.prefix_cache is not None:
+                    self.prefix_cache.unpin(pl.seg)
+                    pl.seg = None
+                self.pool.release(pl.slot)
+                self.scheduler.requeue(pl.req)
+            self._pending_prefills.clear()
         live = [(s, st) for s, st in enumerate(self._slots)
                 if st is not None]
         chunked = bool(live) and self._use_chunked_replay()
@@ -4063,6 +4606,11 @@ class ServingEngine:
         (possibly corrupt — nothing will dispatch to it again)."""
         now = time.perf_counter()
         self._inflight = None
+        while self._pending_prefills:
+            self._drop_pending(
+                self._pending_prefills.popleft(),
+                RequestStatus.FAILED, error,
+            )
         for slot, st in enumerate(self._slots):
             if st is not None:
                 self._retire(slot, RequestStatus.FAILED, now, error=error)

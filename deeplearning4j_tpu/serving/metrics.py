@@ -190,6 +190,13 @@ class ServingMetrics:
         self.n_prefix_evictions = 0
         # admissions coalesced into shared same-bucket prefill dispatches
         self.n_batched_admissions = 0
+        # chunked-prefill piggyback (see engine): bounded prefill
+        # chunks executed for deferred admissions (fused into a decode
+        # dispatch or standalone), their token total, and wall seconds
+        # occupied decode slots sat behind admission prefill work
+        self.n_prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.decode_stall_seconds = 0.0
         # embedding requests served host-side (no KV slot)
         self.n_embeddings = 0
         self.embed_latency = Reservoir(reservoir_cap)
@@ -291,6 +298,16 @@ class ServingMetrics:
             "serve_prefill_batched_total",
             "Admissions coalesced into shared same-bucket prefill "
             "dispatches.",
+        )
+        self._c_prefill_chunks = reg.counter(
+            "serve_prefill_chunks_total",
+            "Bounded prefill chunks executed for deferred piggyback "
+            "admissions (fused or standalone).",
+        )
+        self._c_decode_stall = reg.counter(
+            "serve_decode_stall_seconds_total",
+            "Wall seconds occupied decode slots sat stalled behind "
+            "admission prefill work.",
         )
         self._c_rejections = reg.counter(
             "serve_rejections_total",
@@ -680,6 +697,21 @@ class ServingMetrics:
         self.n_batched_admissions += int(n)
         self._c_batched.inc(int(n))
 
+    def record_prefill_chunk(self, tokens: int) -> None:
+        """One bounded prefill chunk executed for a deferred
+        (piggyback) admission — fused into a decode dispatch or run
+        standalone under the per-horizon token budget."""
+        self.n_prefill_chunks += 1
+        self.prefill_chunk_tokens += int(tokens)
+        self._c_prefill_chunks.inc()
+
+    def record_decode_stall(self, seconds: float) -> None:
+        """Wall time occupied decode slots waited on admission
+        prefill work (measured piggyback-on AND -off, so the bench
+        comparison prices the stall reduction honestly)."""
+        self.decode_stall_seconds += float(seconds)
+        self._c_decode_stall.inc(float(seconds))
+
     def record_outcome(self, status, tenant: str = "") -> None:
         """Non-FINISHED terminal outcome (status is a
         ``RequestStatus`` or its string value)."""
@@ -791,6 +823,11 @@ class ServingMetrics:
             out["prefix_evictions"] = self.n_prefix_evictions
         if self.n_batched_admissions:
             out["batched_admissions"] = self.n_batched_admissions
+        if self.n_prefill_chunks:
+            out["prefill_chunks"] = self.n_prefill_chunks
+            out["prefill_chunk_tokens"] = self.prefill_chunk_tokens
+        if self.decode_stall_seconds > 0:
+            out["decode_stall_s"] = round(self.decode_stall_seconds, 6)
         if self.n_embeddings:
             out["n_embeddings"] = self.n_embeddings
             out["embedding_p50_s"] = _pct(self.embed_latency, 50)
