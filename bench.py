@@ -78,6 +78,13 @@ per workload — the driver's round record captures all of them:
                   headlines p99 TPOT (decode streams stop stalling
                   behind monolithic prefills), p50/p99 TTFT on-vs-off,
                   and prefill-stall seconds in-row
+- ``transformer-decode-serve-grammar`` the production sampling
+                  surface: the unconstrained serve trace through the
+                  masked decode program (surface armed) vs the plain
+                  one — the fold-out overhead unconstrained traffic
+                  pays — plus a mixed trace where a quarter of the
+                  requests carry a JSON-schema response_format and must
+                  emit parsing, validating JSON (validity 1.0 in-row)
 - ``transformer-decode-serve-tp`` the serve trace at a fixed global
                   batch with the fused decode program + KV pool sharded
                   over TP in {1,2,4,8} devices: headlines per-chip
@@ -1167,6 +1174,140 @@ def _bench_decode_serve_piggyback(args, n_slots: int = 4,
     return tok_per_sec, metric, extra
 
 
+def _bench_decode_serve_grammar(args, n_slots: int = 8,
+                                n_requests: int = 32,
+                                n_constrained: int = 8,
+                                mean_interarrival_s: float = 0.01):
+    """The production sampling surface priced two ways on the serve
+    trace. (1) Overhead: the same all-unconstrained trace served by a
+    plain engine vs a ``sampling_surface=True`` engine — every decode
+    dispatch now runs the masked program (DFA mask gather, bias
+    scatter, top_p sort, logprob gather all folded out as no-ops), so
+    the tok/s ratio is the price unconstrained traffic pays for the
+    surface being armed (byte-parity of the streams is pinned by
+    tests/test_serving_grammar.py; this row only prices it). (2)
+    Validity: a mixed trace where ``n_constrained`` requests carry a
+    JSON-schema ``response_format`` and sample at the engine
+    temperature — every constrained output must parse as JSON AND
+    validate against its schema (validity 1.0 is the tentpole's
+    guarantee, measured end-to-end here). Both engines use the exact
+    top-k sort: ``lax.approx_max_k`` reorders ties, so the surface
+    refuses to arm over it. The metric value is the surface-on
+    engine's aggregate tok/s on the unconstrained trace."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import init_transformer
+    from deeplearning4j_tpu.serving import (
+        Request,
+        RequestScheduler,
+        ServingEngine,
+        ServingMetrics,
+        run_request_trace,
+    )
+    from deeplearning4j_tpu.serving.grammar import validate_json_value
+
+    cfg, _, p = _decode_bench_cfg(args, batch=1, gqa=True)
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, n_requests))
+    prompts = rng.integers(
+        0, p["vocab"], (n_requests, _DECODE_PROMPT_LEN)
+    ).astype(np.int32)
+    # bounded-output schema: every field has a finite value set, so a
+    # constrained stream always reaches the accepting state (and EOS)
+    # within max_new tokens — an unbounded integer sampled at T=1.0
+    # could out-digit the budget and be truncated mid-value
+    schema = {
+        "type": "object",
+        "properties": {
+            "a": {"type": "boolean"},
+            "b": {"enum": ["low", "mid", "high"]},
+        },
+        "required": ["a", "b"],
+    }
+    eos = p["vocab"] - 1
+    constrained_at = set(
+        np.linspace(n_requests // 4, 3 * n_requests // 4, n_constrained)
+        .astype(int).tolist()
+    )
+
+    def make_trace(constrained):
+        reqs = []
+        for i in range(n_requests):
+            if constrained and i in constrained_at:
+                r = Request(
+                    prompt=prompts[i], max_new=_DECODE_NEW,
+                    eos_token=eos,
+                    response_format={
+                        "type": "json_schema", "schema": schema,
+                    },
+                )
+            else:
+                r = Request(prompt=prompts[i], max_new=_DECODE_NEW)
+            reqs.append((float(arrivals[i]), r))
+        return reqs
+
+    def make_engine(surface):
+        return ServingEngine(
+            cfg, params, n_slots=n_slots,
+            max_total=_DECODE_PROMPT_LEN + _DECODE_NEW + 1,
+            temperature=1.0, top_k=40,
+            approx_top_k=False,
+            prefill_max_bucket=_DECODE_PROMPT_LEN,
+            sampling_surface=surface,
+            scheduler=RequestScheduler(max_queue_depth=n_requests),
+        )
+
+    def point(surface, constrained):
+        engine = make_engine(surface)
+        run_request_trace(engine, make_trace(constrained))  # warmup
+        engine.metrics = ServingMetrics()
+        engine.metrics.decode_horizon = engine.decode_horizon
+        trace = make_trace(constrained)
+        t0 = time.perf_counter()
+        results = run_request_trace(engine, trace)
+        dt = time.perf_counter() - t0
+        assert all(r.id in results for _, r in trace)
+        s = engine.metrics.summary()
+        return s["n_generated"] / dt, s, engine, trace, results
+
+    off_tps, _, _, _, _ = point(False, False)
+    on_tps, on_s, on_eng, _, _ = point(True, False)
+    mix_tps, _, _, mix_trace, mix_results = point(True, True)
+    n_valid = 0
+    for _, r in mix_trace:
+        if r.response_format is None:
+            continue
+        # the trace result is the full sequence (prompt + generated
+        # + eos); only the generated span is grammar-constrained
+        toks = [int(t) for t in mix_results[r.id][len(r.prompt):]
+                if int(t) != eos and int(t) < 256]
+        try:
+            value = json.loads(bytes(toks).decode("latin-1"))
+            ok = validate_json_value(value, schema)
+        except (ValueError, UnicodeDecodeError):
+            ok = False
+        n_valid += bool(ok)
+    tok_per_sec = on_tps
+    extra = {
+        "off_tok_per_sec": round(off_tps, 1),
+        "surface_overhead_ratio": round(
+            on_tps / max(off_tps, 1e-9), 3),
+        "mixed_tok_per_sec": round(mix_tps, 1),
+        "constrained_validity": round(
+            n_valid / max(n_constrained, 1), 3),
+        "n_constrained": n_constrained,
+        "n_requests": n_requests,
+        "tpot_p99_s": round(on_s["tpot_p99_s"], 5),
+        "surface_armed": on_eng._surface,
+        "n_slots": n_slots,
+    }
+    metric = ("transformer_gpt2s_h128_decode_serve_grammar_"
+              "tokens_per_sec_per_chip")
+    return tok_per_sec, metric, extra
+
+
 def _bench_decode_serve_paged(args, n_slots: int = 16,
                               n_requests: int = 48,
                               mean_interarrival_s: float = 0.01):
@@ -2143,6 +2284,7 @@ _ALL_WORKLOADS = (
     "transformer-decode-serve", "transformer-decode-serve-faults",
     "transformer-decode-serve-prefix", "transformer-decode-serve-paged",
     "transformer-decode-serve-piggyback",
+    "transformer-decode-serve-grammar",
     "transformer-decode-serve-tp", "transformer-decode-serve-router",
     "transformer-decode-serve-disagg",
     "transformer-decode-serve-tenant",
@@ -2172,6 +2314,7 @@ _AUTO_DTYPE = {
     "transformer-decode-serve-prefix": "bf16",
     "transformer-decode-serve-paged": "bf16",
     "transformer-decode-serve-piggyback": "bf16",
+    "transformer-decode-serve-grammar": "bf16",
     "transformer-decode-serve-tp": "bf16",
     "transformer-decode-serve-router": "bf16",
     "transformer-decode-serve-disagg": "bf16",
@@ -2301,6 +2444,12 @@ def _run_one_inner(args, jax) -> None:
             _report(args, per_chip, metric, jax, extra=extra,
                     remeasure=lambda: (
                         _bench_decode_serve_piggyback(args)[0], None))
+            return
+        if args.model == "transformer-decode-serve-grammar":
+            per_chip, metric, extra = _bench_decode_serve_grammar(args)
+            _report(args, per_chip, metric, jax, extra=extra,
+                    remeasure=lambda: (
+                        _bench_decode_serve_grammar(args)[0], None))
             return
         if args.model == "transformer-decode-serve-tp":
             per_chip, metric, extra = _bench_decode_serve_tp(args)

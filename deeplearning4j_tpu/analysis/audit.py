@@ -312,7 +312,8 @@ def check_surface(cfg, geom, specs) -> list[AuditFinding]:
                 out.add(tuple(int(g) for g in m.groups()))
         return out
 
-    for fam in ("step", "paged_step"):
+    for fam in ("step", "paged_step", "masked_step",
+                "paged_masked_step"):
         got_step = {k for (k,) in keyed(fam + r"\[K=(\d+)\]")}
         if got_step != exp[fam]:
             f.append(AuditFinding(
@@ -342,7 +343,9 @@ def check_surface(cfg, geom, specs) -> list[AuditFinding]:
                 f"(bucket, group) grid {sorted(got)} != expected "
                 f"{sorted(exp[fam])}",
             ))
-    for fam in ("piggyback_step", "paged_piggyback_step"):
+    for fam in ("piggyback_step", "paged_piggyback_step",
+                "masked_piggyback_step",
+                "paged_masked_piggyback_step"):
         got = keyed(fam + r"\[b=(\d+),K=(\d+)\]")
         if got != exp[fam]:
             f.append(AuditFinding(

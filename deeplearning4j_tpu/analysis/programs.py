@@ -42,8 +42,11 @@ from deeplearning4j_tpu.serving.engine import (
     build_chunk_program,
     build_deact_program,
     build_hit_insert_program,
+    build_gstate_set_program,
     build_insert_program,
     build_logit_row_program,
+    build_masked_piggyback_program,
+    build_masked_step_program,
     build_paged_insert_program,
     build_paged_prefill_program,
     build_paged_seg_fetch_program,
@@ -101,6 +104,13 @@ class ServingGeometry:
     # compiles the chunk/scratch-slab programs (suffix path, probes)
     paged: bool = False
     block_size: int = 8
+    # production sampling surface (``ServingEngine(sampling_surface=
+    # True)``): masked step/piggyback variants replace the plain ones
+    # at dispatch time, plus the single-row grammar-state seat program
+    sampling_surface: bool = False
+    grammar_states: int = 64
+    n_bias: int = 8
+    n_logprobs: int = 8
 
     def blocks_per_slot(self, cfg: TransformerConfig) -> int:
         """Table width — mirrors ``PagedKVPool``'s Tpad/block split."""
@@ -216,6 +226,21 @@ class _FamilyAvals:
             (n,) + key_shape, jnp.uint32
         )
         self.adapters = _i32(n)
+        # sampling-surface avals: per-slot traced sampling vectors plus
+        # the shared device DFA tables (mask bitmask words + absolute
+        # transition rows) — mirrors the engine's mirrors/_gtable
+        self.gstate = _i32(n)
+        self.temps = jax.ShapeDtypeStruct((n,), jnp.float32)
+        self.topks = _i32(n)
+        self.topps = jax.ShapeDtypeStruct((n,), jnp.float32)
+        self.bias_idx = _i32(n, geom.n_bias)
+        self.bias_val = jax.ShapeDtypeStruct(
+            (n, geom.n_bias), jnp.float32
+        )
+        self.mask_tab = jax.ShapeDtypeStruct(
+            (geom.grammar_states, -(-v // 32)), jnp.uint32
+        )
+        self.trans_tab = _i32(geom.grammar_states, v)
         if geom.paged:
             # blocks leaves mirror PagedKVPool._alloc_caches: the slab
             # leaf's (slot, Tpad) plane becomes (n_blocks, block_size)
@@ -242,6 +267,13 @@ class _FamilyAvals:
     def paged_state(self):
         return (self.paged_caches, self.logits, self.pos, self.active,
                 self.budget, self.eos)
+
+    def surface_tail(self):
+        """The masked programs' trailing arguments, in ``mstep``
+        signature order (after ``params`` + the slot state)."""
+        return (self.gstate, self.slot_keys, self.adapters,
+                self.temps, self.topks, self.topps, self.bias_idx,
+                self.bias_val, self.mask_tab, self.trans_tab)
 
 
 def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
@@ -345,6 +377,44 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
                     ),
                     n_substeps=k + 1,
                 )
+    nl = min(geom.n_logprobs, cfg.vocab_size)
+    if geom.sampling_surface and want("masked_step"):
+        # masked variants: same unrolled chain + the traced sampling
+        # vectors and DFA tables, so the per-substep collective count
+        # matches the plain family exactly
+        for k in geom.horizons():
+            add(
+                f"masked_step[K={k}]", "masked_step",
+                lambda k=k: (
+                    build_masked_step_program(av.fwd1, k, nl),
+                    (av.params, *av.state(), *av.surface_tail()),
+                ),
+                n_substeps=k,
+            )
+    if geom.sampling_surface and want("masked_piggyback_step"):
+        for b in geom.buckets(cfg):
+            for k in geom.horizons():
+                add(
+                    f"masked_piggyback_step[b={b},K={k}]",
+                    "masked_piggyback_step",
+                    lambda b=b, k=k: (
+                        build_masked_piggyback_program(
+                            av.fwd1, av.fwd_chunk, k, nl
+                        ),
+                        (av.params, *av.state(), *av.surface_tail(),
+                         av.scratch, _i32(1, b), _i32(), _i32(),
+                         _i32(1)),
+                    ),
+                    n_substeps=k + 1,
+                )
+    if geom.sampling_surface and want("gstate_set"):
+        add(
+            "gstate_set", "gstate_set",
+            lambda: (
+                build_gstate_set_program(),
+                (av.gstate, _i32(), _i32()),
+            ),
+        )
     if want("insert"):
         add(
             "insert", "insert",
@@ -417,6 +487,37 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
                         ),
                         (av.params, *av.paged_state(), av.slot_keys,
                          av.adapters, av.scratch, _i32(1, b),
+                         _i32(), _i32(), _i32(1)),
+                    ),
+                    n_substeps=k + 1,
+                )
+    if geom.paged and geom.sampling_surface and want("paged_masked_step"):
+        for k in geom.horizons():
+            add(
+                f"paged_masked_step[K={k}]", "paged_masked_step",
+                lambda k=k: (
+                    build_masked_step_program(
+                        make_paged_fwd1(av.fwd1), k, nl
+                    ),
+                    (av.params, *av.paged_state(),
+                     *av.surface_tail()),
+                ),
+                n_substeps=k,
+            )
+    if (geom.paged and geom.sampling_surface
+            and want("paged_masked_piggyback_step")):
+        for b in geom.buckets(cfg):
+            for k in geom.horizons():
+                add(
+                    f"paged_masked_piggyback_step[b={b},K={k}]",
+                    "paged_masked_piggyback_step",
+                    lambda b=b, k=k: (
+                        build_masked_piggyback_program(
+                            make_paged_fwd1(av.fwd1), av.fwd_chunk,
+                            k, nl,
+                        ),
+                        (av.params, *av.paged_state(),
+                         *av.surface_tail(), av.scratch, _i32(1, b),
                          _i32(), _i32(), _i32(1)),
                     ),
                     n_substeps=k + 1,
@@ -515,7 +616,8 @@ def _specs_for(av: _FamilyAvals, geom: ServingGeometry, *,
 #: forward-pass families — the ones whose TP variants carry the
 #: collective contract (the copy/slice programs contain no model code)
 _FORWARD_FAMILIES = {"step", "replay", "prefill", "chunk",
-                     "piggyback_step"}
+                     "piggyback_step", "masked_step",
+                     "masked_piggyback_step"}
 
 
 def enumerate_programs(
@@ -542,7 +644,8 @@ def enumerate_programs(
             # TP paged serving exists (paged-parity TP tests), so its
             # forward variants carry the same collective contract
             fams |= {"paged_step", "paged_replay", "paged_prefill",
-                     "paged_piggyback_step"}
+                     "paged_piggyback_step", "paged_masked_step",
+                     "paged_masked_piggyback_step"}
         specs += _specs_for(
             _FamilyAvals(cfg_tp, geom, tp_mesh=mesh), geom,
             tp=True, suffix=f"[tp={geom.tp}]",
@@ -582,6 +685,9 @@ def expected_surface(
             "paged_replay", "paged_insert", "paged_seg_fetch",
             "paged_seg_import", "block_copy",
         }
+    if geom.sampling_surface:
+        singletons |= {"gstate_set"}
+    pb_grid = {(b, k) for b in buckets for k in geom.horizons()}
     return {
         "step": set(geom.horizons()),
         "prefill": buckets,
@@ -595,12 +701,27 @@ def expected_surface(
         # piggyback: the pow2 chunk grid crossed with the step
         # horizons — the fused-program surface is bounded by
         # O(log max_bucket) x |{1, K}|
-        "piggyback_step": {
-            (b, k) for b in buckets for k in geom.horizons()
-        },
+        "piggyback_step": set(pb_grid),
         "paged_piggyback_step": (
-            {(b, k) for b in buckets for k in geom.horizons()}
-            if geom.paged else set()
+            set(pb_grid) if geom.paged else set()
+        ),
+        # masked (sampling-surface) variants share the plain families'
+        # key grids — a surface engine compiles masked programs
+        # INSTEAD of the plain ones per dispatch, so the total live
+        # surface stays within the same O(log) envelope
+        "masked_step": (
+            set(geom.horizons()) if geom.sampling_surface else set()
+        ),
+        "paged_masked_step": (
+            set(geom.horizons())
+            if geom.sampling_surface and geom.paged else set()
+        ),
+        "masked_piggyback_step": (
+            set(pb_grid) if geom.sampling_surface else set()
+        ),
+        "paged_masked_piggyback_step": (
+            set(pb_grid)
+            if geom.sampling_surface and geom.paged else set()
         ),
         "singletons": singletons,
         "log_bound": int(math.log2(mb)) + 1,
@@ -629,6 +750,7 @@ def live_engine_families(engine) -> dict[str, set]:
         ("paged_seg_import",
          getattr(engine, "_paged_seg_import_fn", None)),
         ("block_copy", getattr(engine, "_block_copy_fn", None)),
+        ("gstate_set", getattr(engine, "_gstate_set_fn", None)),
     ):
         if fn is not None:
             singles.add(name)
@@ -637,6 +759,8 @@ def live_engine_families(engine) -> dict[str, set]:
     # same for the fused piggyback cache, keyed (bucket, K)
     steps = set(engine._step_fns)
     pb = set(getattr(engine, "_piggyback_fns", {}))
+    msteps = set(getattr(engine, "_masked_step_fns", {}) or {})
+    mpb = set(getattr(engine, "_masked_piggyback_fns", {}) or {})
     return {
         "step": set() if paged else steps,
         "paged_step": steps if paged else set(),
@@ -647,6 +771,10 @@ def live_engine_families(engine) -> dict[str, set]:
         "batch_hit": set(engine._batch_hit_fns),
         "piggyback_step": set() if paged else pb,
         "paged_piggyback_step": pb if paged else set(),
+        "masked_step": set() if paged else msteps,
+        "paged_masked_step": msteps if paged else set(),
+        "masked_piggyback_step": set() if paged else mpb,
+        "paged_masked_piggyback_step": mpb if paged else set(),
         "singletons": singles,
     }
 
@@ -689,6 +817,8 @@ def default_audit_geometry() -> ServingGeometry:
         prefix_segments=2,
         paged=True,
         block_size=8,
+        sampling_surface=True,
+        grammar_states=64,
     )
 
 

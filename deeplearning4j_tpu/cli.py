@@ -541,6 +541,12 @@ def cmd_serve(args) -> int:
         block_size=args.block_size,
         piggyback=args.piggyback,
         prefill_budget=args.prefill_budget,
+        sampling_surface=args.sampling_surface,
+        grammar_states=args.grammar_states,
+        grammar_cache=(
+            os.path.expanduser(args.grammar_cache)
+            if args.grammar_cache else None
+        ),
         scheduler=RequestScheduler(
             max_queue_depth=args.max_queue,
             prefix_affinity_tokens=args.prefix_affinity_tokens,
@@ -588,6 +594,16 @@ def cmd_serve(args) -> int:
             print(f"tensor parallel DISABLED (parity probe failed or "
                   f"geometry unsupported); serving on 1 device",
                   file=sys.stderr)
+    if args.sampling_surface:
+        if engine._surface:
+            print(f"sampling surface: grammar-constrained decoding + "
+                  f"per-request temperature/top_k/top_p/stop/"
+                  f"logit_bias/logprobs "
+                  f"({engine._gtable.capacity} DFA table rows)")
+        else:
+            print("sampling surface DISABLED (masked parity probe "
+                  "failed or approx-top-k engine); per-request "
+                  "sampling fields will 400", file=sys.stderr)
     server = ServingServer(
         engine, host=args.host, port=args.port,
         request_timeout_s=args.request_timeout,
@@ -1183,6 +1199,23 @@ def main(argv: list[str] | None = None) -> int:
                    metavar="N",
                    help="piggyback prefill token budget per decode "
                    "horizon (default: 2x the largest prefill bucket)")
+    v.add_argument("--sampling-surface", action="store_true",
+                   help="enable the production sampling surface: "
+                   "grammar-constrained decoding (response_format with "
+                   "a JSON schema or regex), per-request temperature/"
+                   "top_k/top_p overrides, stop sequences, logit_bias "
+                   "and logprobs. One masked program family serves "
+                   "every request mix; unconstrained streams stay "
+                   "byte-identical, gated by a one-time parity probe")
+    v.add_argument("--grammar-states", type=int, default=256,
+                   metavar="N",
+                   help="device DFA table rows shared by all seated "
+                   "grammars (default: 256); compiles whose DFA "
+                   "exceeds the free budget are rejected with 400")
+    v.add_argument("--grammar-cache", type=str, default=None,
+                   metavar="DIR",
+                   help="on-disk grammar compile cache directory "
+                   "(default: in-memory LRU only)")
     v.add_argument("--prefix-affinity-tokens", type=int, default=0,
                    metavar="K",
                    help="scheduler promotes a queued request whose "

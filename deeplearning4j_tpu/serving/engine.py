@@ -166,6 +166,16 @@ from deeplearning4j_tpu.serving.faults import (
     TransientFault,
 )
 from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
+from deeplearning4j_tpu.serving.grammar import (
+    MAX_LOGIT_BIAS,
+    MAX_TOP_LOGPROBS,
+    GrammarCache,
+    GrammarError,
+    GrammarTable,
+    StopMatcher,
+    default_token_bytes,
+    parse_response_format,
+)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache, Segment
 from deeplearning4j_tpu.serving.probe_cache import ProbeCache, probe_key
@@ -227,6 +237,20 @@ PROGRAM_DONATION: dict[str, tuple[int, ...]] = {
     # consumed by the fused program's updated-scratch output
     "piggyback_step": (1, 2, 3, 4, 5, 9),
     "paged_piggyback_step": (1, 2, 3, 4, 5, 9),
+    # masked step (grammar-constrained decoding + per-request sampling
+    # surface): decode state donated as "step" (argnums 1..5) plus the
+    # per-slot grammar FSM state vector (argnum 7), consumed by the
+    # program's advanced-state output. The mask/transition tables are
+    # NOT donated — they are reused across dispatches and shared with
+    # the host mirror.
+    "masked_step": (1, 2, 3, 4, 5, 7),
+    "paged_masked_step": (1, 2, 3, 4, 5, 7),
+    # masked piggyback adds the admitting slot's chunk scratch slab
+    # (argnum 17), as "piggyback_step" donates its argnum 9
+    "masked_piggyback_step": (1, 2, 3, 4, 5, 7, 17),
+    "paged_masked_piggyback_step": (1, 2, 3, 4, 5, 7, 17),
+    # single-slot grammar-state seat (admission), like "deactivate"
+    "gstate_set": (0,),
 }
 
 
@@ -410,6 +434,189 @@ def build_piggyback_program(fwd1, fwd_chunk, horizon: int,
                 jnp.stack(toks_all, axis=1), tmp, clg)
 
     return pstep
+
+
+def _dyn_top_k_filter(logits, top_ks):
+    """Per-slot top-k filter with a TRACED k vector. ``_top_k_filter``
+    thresholds at ``lax.top_k(logits, k)[0][..., -1]`` — the kth order
+    statistic — and an ascending full sort gathered at ``V - k`` yields
+    the same float value, so the subsequent ``where(logits < kth)``
+    keeps bitwise-identical rows. ``k == 0`` is the no-filter sentinel
+    (engine-wide ``top_k=None``), folded out so those slots keep the
+    raw logits object untouched."""
+    vs = logits.shape[-1]
+    srt = jnp.sort(logits, axis=-1)
+    idx = jnp.clip(vs - top_ks, 0, vs - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(srt, idx[:, None], axis=-1)
+    filt = jnp.where(logits < kth, -jnp.inf, logits)
+    return jnp.where((top_ks > 0)[:, None], filt, logits)
+
+
+def _top_p_filter(scaled, top_ps):
+    """Per-slot nucleus filter on the temperature-scaled logits: keep
+    the smallest descending-probability prefix whose mass reaches
+    top_p (the token crossing the threshold is kept, standard nucleus
+    semantics). ``top_p == 1`` is the no-filter sentinel, folded out
+    so unfiltered slots keep ``scaled`` bitwise."""
+    srt = -jnp.sort(-scaled, axis=-1)
+    probs = jax.nn.softmax(srt, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = (csum - probs) < top_ps[:, None]
+    cut = jnp.min(
+        jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
+    )
+    out = jnp.where(scaled < cut, -jnp.inf, scaled)
+    return jnp.where((top_ps < 1.0)[:, None], out, scaled)
+
+
+def _masked_draw(logits, pos, active, gstate, keys, temps, top_ks,
+                 top_ps, bias_idx, bias_val, mask_words, n_logprobs):
+    """One masked substep's draw: grammar mask → logit bias → logprob
+    rows → per-slot top-k → temperature → top-p → greedy/sampled
+    select. Every per-request control sits behind a ``jnp.where`` at
+    its neutral value (state 0, no bias rows, k=0, p=1, engine
+    temperature) so a slot using none of them reproduces the base
+    step program's token stream bitwise — the construction-time
+    masked-parity probe gates exactly that.
+
+    Returns ``(toks, aux)`` where ``aux`` is the packed int32 per-slot
+    row ``[tok, bitcast(chosen logprob), top ids..., bitcast(top
+    logprobs)...]`` — logprobs ride the one existing readback instead
+    of syncing the (slots, V) logits."""
+    vs = logits.shape[-1]
+    # grammar mask: gather each slot's packed row for its current FSM
+    # state and unpack 32 bits/word in-program. Row 0 is the
+    # all-permitted unconstrained sentinel, and the gstate>0 fold
+    # keeps unconstrained rows as the untouched logits object.
+    rows = mask_words[gstate]
+    bits = (
+        rows[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)
+    ) & jnp.uint32(1)
+    allowed = bits.reshape(rows.shape[0], -1)[:, :vs] != 0
+    constrained = (gstate > 0)[:, None]
+    base = jnp.where(constrained & ~allowed, -jnp.inf, logits)
+    # sparse per-slot logit bias: idx<0 rows are padding. The has_bias
+    # fold is load-bearing for parity — ``base + 0.0`` flips -0.0
+    # logits to +0.0.
+    has_bias = jnp.any(bias_idx >= 0, axis=-1)[:, None]
+    idx = jnp.clip(bias_idx, 0, vs - 1)
+    val = jnp.where(bias_idx >= 0, bias_val, 0.0)
+    delta = jax.vmap(
+        lambda i, v: jnp.zeros((vs,), logits.dtype).at[i].add(v)
+    )(idx, val)
+    base = jnp.where(has_bias, base + delta, base)
+    # logprob source: the masked+biased distribution BEFORE
+    # top-k/temperature/top-p shaping — API logprobs describe the
+    # model's constrained distribution, not the sampler's
+    lp = jax.nn.log_softmax(base, axis=-1)
+    filt = _dyn_top_k_filter(base, top_ks)
+    greedy = jnp.argmax(filt, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    scaled = filt / safe_t[:, None]
+    final = _top_p_filter(scaled, top_ps)
+    tok_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+    sampled = jax.vmap(
+        lambda kk, lg: jax.random.categorical(kk, lg)
+    )(tok_keys, final).astype(jnp.int32)
+    toks = jnp.where(temps > 0, sampled, greedy)
+    toks = jnp.where(active, toks, 0)
+    lp_cho = jnp.take_along_axis(lp, toks[:, None], axis=-1)[:, 0]
+    tv, ti = lax.top_k(lp, n_logprobs)
+    aux = jnp.concatenate(
+        [
+            toks[:, None],
+            lax.bitcast_convert_type(lp_cho, jnp.int32)[:, None],
+            ti.astype(jnp.int32),
+            lax.bitcast_convert_type(tv, jnp.int32),
+        ],
+        axis=1,
+    )
+    return toks, aux
+
+
+def build_masked_step_program(fwd1, horizon: int, n_logprobs: int):
+    """Grammar-constrained + per-request-sampling variant of
+    ``build_step_program``: the same unrolled K-substep chain, with a
+    per-slot FSM state vector threaded through it. Each substep masks
+    disallowed tokens BEFORE the draw and advances the state
+    in-program off the chosen token, so K>1 horizons stay constrained
+    without a host round-trip. The token output is replaced by the
+    packed aux tensor (slots, K, 2+2*n_logprobs) whose [:, :, 0] slice
+    is the token stream."""
+
+    def mstep(params, caches, logits, pos, active, budget, eos,
+              gstate, slot_keys_raw, adapters, temps, top_ks, top_ps,
+              bias_idx, bias_val, mask_words, trans_tab):
+        keys = jax.random.wrap_key_data(slot_keys_raw)
+        aux_all = []
+        for k in range(horizon):
+            toks, aux = _masked_draw(  # lint: prng-ok _masked_draw folds pos into the key; pos advances every substep
+                logits, pos, active, gstate, keys, temps, top_ks,
+                top_ps, bias_idx, bias_val, mask_words, n_logprobs,
+            )
+            # advance the FSM off the chosen token; disallowed
+            # transitions are stored as 0 in the table so the gather
+            # never indexes negatively. Inactive and unconstrained
+            # slots hold their state.
+            nxt = trans_tab[gstate, toks]
+            gstate = jnp.where(active & (gstate > 0), nxt, gstate)
+            new_logits, caches = fwd1(
+                params, caches, toks, pos, adapter=adapters
+            )
+            pos = jnp.where(active, pos + 1, pos)
+            budget = jnp.where(active, budget - 1, budget)
+            active = active & (toks != eos) & (budget > 0)
+            logits = new_logits
+            aux_all.append(aux)
+        return (caches, logits, pos, active, budget, gstate,
+                jnp.stack(aux_all, axis=1))
+
+    return mstep
+
+
+def build_masked_piggyback_program(fwd1, fwd_chunk, horizon: int,
+                                   n_logprobs: int):
+    """Masked decode leg + one bounded prefill chunk in a single
+    dispatch — ``build_masked_step_program`` body verbatim plus the
+    ``build_chunk_program`` leg, mirroring how ``piggyback_step``
+    extends ``step``."""
+
+    def mpstep(params, caches, logits, pos, active, budget, eos,
+               gstate, slot_keys_raw, adapters, temps, top_ks,
+               top_ps, bias_idx, bias_val, mask_words, trans_tab,
+               tmp, ctoks, cpos0, clast, cadapter):
+        keys = jax.random.wrap_key_data(slot_keys_raw)
+        aux_all = []
+        for k in range(horizon):
+            toks, aux = _masked_draw(  # lint: prng-ok _masked_draw folds pos into the key; pos advances every substep
+                logits, pos, active, gstate, keys, temps, top_ks,
+                top_ps, bias_idx, bias_val, mask_words, n_logprobs,
+            )
+            nxt = trans_tab[gstate, toks]
+            gstate = jnp.where(active & (gstate > 0), nxt, gstate)
+            new_logits, caches = fwd1(
+                params, caches, toks, pos, adapter=adapters
+            )
+            pos = jnp.where(active, pos + 1, pos)
+            budget = jnp.where(active, budget - 1, budget)
+            active = active & (toks != eos) & (budget > 0)
+            logits = new_logits
+            aux_all.append(aux)
+        clg, tmp = fwd_chunk(
+            params, tmp, ctoks, cpos0, last_idx=clast,
+            adapter=cadapter,
+        )
+        return (caches, logits, pos, active, budget, gstate,
+                jnp.stack(aux_all, axis=1), tmp, clg)
+
+    return mpstep
+
+
+def build_gstate_set_program():
+    """Single-slot grammar-state seat: write one row of the
+    device-resident FSM state vector at admission (and zero it at
+    retirement), like ``build_deact_program``."""
+    return lambda gstate, slot, val: gstate.at[slot].set(val)
 
 
 def build_insert_program():
@@ -714,7 +921,8 @@ class _SlotState:
     """Host-side record for one occupied slot."""
 
     __slots__ = ("req", "tokens", "t_first_token", "gen", "key_data",
-                 "adapter", "segs")
+                 "adapter", "segs", "gkey", "gstate0", "stop_matcher",
+                 "lp_out", "n_stripped")
 
     def __init__(self, req: Request, gen: int, key_data,
                  adapter: int = 0):
@@ -732,6 +940,16 @@ class _SlotState:
         # admission read + the one its prompt inserted); unpinned at
         # retirement so LRU eviction can reclaim them
         self.segs: list[Segment] = []
+        # sampling-surface state (engines with sampling_surface=True):
+        # gkey/gstate0 pin the seated grammar's table rows + start
+        # state so crash recovery can re-walk the transition table
+        # over st.tokens; the stop matcher holds back a rolling
+        # suffix; lp_out collects per-token logprob records
+        self.gkey = None
+        self.gstate0 = 0
+        self.stop_matcher: StopMatcher | None = None
+        self.lp_out: list | None = None
+        self.n_stripped = 0
 
 
 class _AdmitPlan:
@@ -886,6 +1104,10 @@ class ServingEngine:
         piggyback: bool = False,
         prefill_budget: int | None = None,
         piggyback_parity: bool | str = "auto",
+        sampling_surface: bool = False,
+        masked_parity: bool | str = "auto",
+        grammar_states: int = 256,
+        grammar_cache: str | GrammarCache | None = None,
     ):
         self.n_slots = n_slots
         self.max_total = int(min(max_total or cfg.max_len, cfg.max_len))
@@ -1314,6 +1536,95 @@ class ServingEngine:
                     _log, "piggyback_parity_probe_failed",
                     fallback="blocking admission prefill",
                 )
+
+        # grammar-constrained decoding + per-request sampling surface:
+        # per-slot FSM state / temperature / top-k / top-p / logit-bias
+        # vectors threaded through masked step variants as traced data
+        # (the adapter-id idiom, one compiled family for every mix),
+        # behind the standing bitwise bar — masked_parity "auto" probes
+        # the masked program against the base step on neutral surface
+        # state once (ProbeCache'd) and leaves the surface off on
+        # mismatch, so base traffic can never be perturbed.
+        self._surface_requested = bool(sampling_surface)
+        self._surface = False
+        self._gtable: GrammarTable | None = None
+        self.grammar_cache: GrammarCache | None = None
+        self._masked_step_fns: dict[int, object] = {}
+        self._masked_piggyback_fns: dict[tuple[int, int], object] = {}
+        self._gstate_set_fn = None
+        self._n_logprobs = min(MAX_TOP_LOGPROBS, cfg.vocab_size)
+        # device copies of the grammar table, refreshed when the host
+        # table's version moves (seat/evict between horizons only)
+        self._gtab_version = -1
+        self._dmask_tab = None
+        self._dtrans_tab = None
+        # host mirrors of the per-slot surface vectors: written at
+        # admission, snapshotted per dispatch, re-seated at recovery
+        # (the _slot_adapters contract). _slot_gstate holds each
+        # slot's ABSOLUTE seat state for recovery re-walks — the live
+        # value is the DEVICE-resident _dgstate carry.
+        self._slot_gstate = np.zeros((n_slots,), np.int32)
+        self._slot_temps = np.full(
+            (n_slots,), self.temperature, np.float32
+        )
+        self._slot_topks = np.full(
+            (n_slots,), int(self.top_k or 0), np.int32
+        )
+        self._slot_topps = np.ones((n_slots,), np.float32)
+        self._slot_bias_idx = np.full(
+            (n_slots, MAX_LOGIT_BIAS), -1, np.int32
+        )
+        self._slot_bias_val = np.zeros(
+            (n_slots, MAX_LOGIT_BIAS), np.float32
+        )
+        self._dgstate = jnp.zeros((n_slots,), jnp.int32)
+        if self._surface_requested and masked_parity is not False:
+            if self.approx_top_k:
+                # approx_max_k has no traced-k variant with identical
+                # tie semantics, so the parity bar is unmeetable;
+                # surface requests are rejected at submit instead
+                log_event(_log, "sampling_surface_disabled",
+                          reason="approx_top_k")
+            else:
+                self._gtable = GrammarTable(
+                    max(2, int(grammar_states)), cfg.vocab_size
+                )
+                ok = (
+                    True if masked_parity is True
+                    else self._probe_verdict(
+                        "masked_parity",
+                        self._probe_masked_parity,
+                        n_slots=self.n_slots,
+                        max_total=self.max_total,
+                        max_bucket=self._max_bucket,
+                        tp=self.tp,
+                        paged=self._paged,
+                        piggyback=self._piggyback,
+                        temperature=self.temperature,
+                        top_k=self.top_k,
+                        horizon=self.decode_horizon,
+                        grammar_states=self._gtable.capacity,
+                        n_logprobs=self._n_logprobs,
+                    )
+                )
+                if ok:
+                    self._surface = True
+                    self.grammar_cache = (
+                        grammar_cache
+                        if isinstance(grammar_cache, GrammarCache)
+                        else GrammarCache(grammar_cache)
+                    )
+                    self.metrics.registry.gauge(
+                        "serve_grammar_table_rows",
+                        "Grammar DFA table rows in use (incl. the "
+                        "unconstrained sentinel row).",
+                    ).set_function(lambda: self._gtable.rows_used)
+                else:
+                    self._gtable = None
+                    log_event(
+                        _log, "masked_parity_probe_failed",
+                        fallback="sampling surface disabled",
+                    )
         # arm attribution last: everything dispatched above was a probe
         self._attr_enabled = bool(attribution)
 
@@ -1556,6 +1867,82 @@ class ServingEngine:
             self._piggyback_fns[(bucket, horizon)] = fn
         return fn
 
+    def _masked_step_fn_for(self, horizon: int):
+        """The compiled masked (grammar + sampling surface) step for
+        ``horizon`` substeps. Engine-wide temperature/top_k are NOT in
+        the shared-program key: they ride as per-slot traced vectors,
+        so one compiled family serves every sampling mix."""
+        fn = self._masked_step_fns.get(horizon)
+        if fn is None:
+            fn = _shared_program(
+                self._prog_key + (
+                    "masked_step", horizon, self._n_logprobs,
+                ),
+                lambda: jax.jit(
+                    build_masked_step_program(
+                        make_paged_fwd1(self._fwd1) if self._paged
+                        else self._fwd1,
+                        horizon, self._n_logprobs,
+                    ),
+                    donate_argnums=self._donate(
+                        "paged_masked_step" if self._paged
+                        else "masked_step"
+                    ),
+                ),
+            )
+            self._masked_step_fns[horizon] = fn
+        return fn
+
+    def _masked_piggyback_fn(self, bucket: int, horizon: int):
+        """Jitted masked chunk+decode piggyback program (see
+        :func:`build_masked_piggyback_program`); per-(bucket, K) dict
+        keys express the compile surface the audit fences."""
+        fn = self._masked_piggyback_fns.get((bucket, horizon))
+        if fn is None:
+            fn = _shared_program(
+                self._prog_key + (
+                    "masked_piggyback_step", horizon, self._n_logprobs,
+                ),
+                lambda: jax.jit(
+                    build_masked_piggyback_program(
+                        make_paged_fwd1(self._fwd1) if self._paged
+                        else self._fwd1,
+                        self._fwd_chunk, horizon, self._n_logprobs,
+                    ),
+                    donate_argnums=self._donate(
+                        "paged_masked_piggyback_step" if self._paged
+                        else "masked_piggyback_step"
+                    ),
+                ),
+            )
+            self._masked_piggyback_fns[(bucket, horizon)] = fn
+        return fn
+
+    def _gstate_set(self):
+        """Jitted single-slot grammar-state write (see
+        :func:`build_gstate_set_program`)."""
+        if self._gstate_set_fn is None:
+            self._gstate_set_fn = _shared_program(
+                self._prog_key + ("gstate_set",),
+                lambda: jax.jit(
+                    build_gstate_set_program(),
+                    donate_argnums=self._donate("gstate_set"),
+                ),
+            )
+        return self._gstate_set_fn
+
+    def _grammar_device_tables(self):
+        """Device copies of the combined grammar mask/transition
+        tables, refreshed exactly when the host table's version moved
+        (seats and evictions happen between horizons, admission-side,
+        so a dispatch never races this)."""
+        gt = self._gtable
+        if self._gtab_version != gt.version:
+            self._dmask_tab = jnp.asarray(gt.mask_words)
+            self._dtrans_tab = jnp.asarray(gt.trans)
+            self._gtab_version = gt.version
+        return self._dmask_tab, self._dtrans_tab
+
     def _insert(self):
         """Jitted slab insert + state set (see
         :func:`build_insert_program`)."""
@@ -1795,6 +2182,36 @@ class ServingEngine:
                 f"request {req.id}: adapter {req.adapter} outside the "
                 f"loaded bank ({self.n_adapters} adapters)"
             )
+        if getattr(req, "uses_sampling_surface", False):
+            if not self._surface:
+                raise AdmissionError(
+                    f"request {req.id}: sampling-surface fields "
+                    "(temperature/top_k/top_p/stop/logit_bias/"
+                    "logprobs/response_format) need an engine built "
+                    "with sampling_surface=True"
+                )
+            if req.response_format is not None:
+                if req.eos_token is None:
+                    raise AdmissionError(
+                        f"request {req.id}: response_format requires "
+                        "eos_token (grammars terminate by permitting "
+                        "EOS in accepting states)"
+                    )
+                kind, spec = parse_response_format(req.response_format)
+                try:
+                    cg, how = self.grammar_cache.get_or_compile(
+                        kind, spec,
+                        default_token_bytes(self.cfg.vocab_size),
+                        req.eos_token,
+                        max_states=self._gtable.capacity - 1,
+                    )
+                except GrammarError as e:
+                    self.metrics.record_grammar_compile("error")
+                    raise AdmissionError(
+                        f"request {req.id}: {e}"
+                    ) from None
+                self.metrics.record_grammar_compile(how)
+                req._grammar = cg
         try:
             rid = self.scheduler.submit(req)
         except Backpressure as e:
@@ -1908,6 +2325,11 @@ class ServingEngine:
                 continue
             if st.req.cancelled or st.req.expired(now):
                 continue  # the lifecycle sweep owns these
+            if getattr(st.req, "uses_sampling_surface", False):
+                # sampling-surface state (grammar FSM position, stop
+                # hold-back, bias rows) does not travel on the KVSG
+                # wire; these slots drain locally via preempt/recovery
+                continue
             t0 = time.perf_counter()
             req = st.req
             try:
@@ -2086,6 +2508,13 @@ class ServingEngine:
             for seg in st.segs:
                 self.prefix_cache.unpin(seg)
         st.segs = []
+        if self._surface:
+            if st.stop_matcher is not None and req.stream is not None:
+                # release the hold-back before the end-of-stream
+                # sentinel (empty when a stop match consumed it)
+                for t in st.stop_matcher.flush():
+                    req.stream.put(t)
+            self._clear_surface(slot, st)
         self._slots[slot] = None
         if deactivate:
             self._dactive = self._deact_fn(self._dactive, jnp.int32(slot))
@@ -2891,6 +3320,109 @@ class ServingEngine:
             self.prefill_dispatches = _disp
             self._attr_suspend -= 1
 
+    def _probe_masked_parity(self) -> bool:
+        """One-time probe gating the sampling surface: does the MASKED
+        step program — grammar mask, logit bias, per-slot temperature/
+        top-k/top-p, logprob gathers all folded behind jnp.where at
+        their neutral values — reproduce, bitwise, the production step
+        program over identical inputs? Every decode-state leaf and the
+        token matrix must match, and the FSM state vector must hold at
+        the unconstrained sentinel. When piggyback is armed the masked
+        piggyback variant is held to the same bar against the plain
+        one. Failure leaves the surface off: base traffic keeps its
+        exact bytes and surface requests 400 at submit (never wrong,
+        just absent)."""
+        k = self.decode_horizon
+        n = self.n_slots
+        vs = self.cfg.vocab_size
+        _disp = self.prefill_dispatches  # probes don't count
+        self._attr_suspend += 1  # nor toward device-time attribution
+        try:
+            def caches0():
+                if self._paged:
+                    return {
+                        "blocks": jax.tree.map(
+                            jnp.zeros_like, self.pool.caches
+                        ),
+                        "tables": jnp.zeros(
+                            (n, self.pool.blocks_per_slot), jnp.int32
+                        ),
+                    }
+                return self._init_caches(n, self.max_total)
+
+            def decode_state():
+                # donation safety: each side gets fresh buffers
+                lg = (
+                    jnp.arange(n * vs, dtype=jnp.float32)
+                    .reshape(n, vs) % 7.0
+                )
+                return (
+                    caches0(), lg,
+                    jnp.arange(n, dtype=jnp.int32) % 3,
+                    jnp.ones((n,), bool),
+                    jnp.full((n,), 5, jnp.int32),
+                    jnp.full((n,), _NO_EOS, jnp.int32),
+                )
+
+            keys = np.arange(
+                self._slot_keys.size, dtype=self._slot_keys.dtype
+            ).reshape(self._slot_keys.shape)
+            ad = jnp.zeros((n,), jnp.int32)
+            # neutral surface vectors: the exact values _seat_surface
+            # writes for a request that sets nothing
+            temps = jnp.full((n,), self.temperature, jnp.float32)
+            topks = jnp.full((n,), int(self.top_k or 0), jnp.int32)
+            topps = jnp.ones((n,), jnp.float32)
+            bidx = jnp.full((n, MAX_LOGIT_BIAS), -1, jnp.int32)
+            bval = jnp.zeros((n, MAX_LOGIT_BIAS), jnp.float32)
+            gstate = jnp.zeros((n,), jnp.int32)
+            mask_tab, trans_tab = self._grammar_device_tables()
+            out_a = self._step_fn_for(k)(
+                self.params, *decode_state(), jnp.asarray(keys), ad
+            )
+            out_b = self._masked_step_fn_for(k)(
+                self.params, *decode_state(), gstate,
+                jnp.asarray(keys), ad, temps, topks, topps, bidx,
+                bval, mask_tab, trans_tab,
+            )
+            ok = bool(
+                self._states_equal(out_a[:5], out_b[:5])
+                and np.array_equal(np.asarray(out_a[5]),
+                                   np.asarray(out_b[6][:, :, 0]))
+                and np.array_equal(np.asarray(out_b[5]),
+                                   np.zeros((n,), np.int32))
+            )
+            if ok and self._piggyback:
+                b = self._max_bucket
+                ctoks = jnp.asarray(
+                    ((1 + np.arange(b)) % vs).astype(np.int32)[None, :]
+                )
+                cad = jnp.zeros((1,), jnp.int32)
+                out_c = self._piggyback_fn(b, k)(
+                    self.params, *decode_state(), jnp.asarray(keys),
+                    ad, self._init_caches(1, self.max_total), ctoks,
+                    jnp.int32(0), jnp.int32(b - 1), cad,
+                )
+                out_d = self._masked_piggyback_fn(b, k)(
+                    self.params, *decode_state(), gstate,
+                    jnp.asarray(keys), ad, temps, topks, topps, bidx,
+                    bval, mask_tab, trans_tab,
+                    self._init_caches(1, self.max_total), ctoks,
+                    jnp.int32(0), jnp.int32(b - 1), cad,
+                )
+                ok = bool(
+                    self._states_equal(out_c[:5], out_d[:5])
+                    and np.array_equal(np.asarray(out_c[5]),
+                                       np.asarray(out_d[6][:, :, 0]))
+                    and self._states_equal(out_c[6], out_d[7])
+                    and np.array_equal(np.asarray(out_c[7]),
+                                       np.asarray(out_d[8]))
+                )
+            return ok
+        finally:
+            self.prefill_dispatches = _disp
+            self._attr_suspend -= 1
+
     def _probe_batch_parity(self) -> bool:
         """One-time probe gating batched admission: do the batched
         same-bucket prefill program (vector last_idx) and — when the
@@ -3568,6 +4100,8 @@ class ServingEngine:
                         req.adapter)
         if pl.seg is not None:
             st.segs.append(pl.seg)
+        if self._surface:
+            self._seat_surface(slot, st, req)
         self._slots[slot] = st
         pl.admitted = True
         req.status = RequestStatus.RUNNING
@@ -3610,6 +4144,67 @@ class ServingEngine:
                   tenant=req.tenant_id or None,
                   adapter=req.adapter or None,
                   trace_id=req.trace_id or None)
+
+    def _seat_surface(self, slot: int, st: _SlotState,
+                      req: Request) -> None:
+        """Seat the slot's sampling-surface rows: host mirror vectors
+        (snapshotted per dispatch, re-seated at recovery — the
+        _slot_adapters contract), the compiled grammar in the combined
+        table, and the DEVICE-resident FSM state row. Defaults
+        reproduce the engine-wide sampler bitwise (temperature/top_k
+        engine values, p=1, no bias, state 0)."""
+        t = (req.temperature if req.temperature is not None
+             else self.temperature)
+        k = req.top_k if req.top_k is not None else (self.top_k or 0)
+        p = req.top_p if req.top_p is not None else 1.0
+        self._slot_temps[slot] = np.float32(t)
+        self._slot_topks[slot] = np.int32(k)
+        self._slot_topps[slot] = np.float32(p)
+        self._slot_bias_idx[slot] = -1
+        self._slot_bias_val[slot] = 0.0
+        if req.logit_bias:
+            for j, (ti, tv) in enumerate(sorted(req.logit_bias.items())):
+                self._slot_bias_idx[slot, j] = ti
+                self._slot_bias_val[slot, j] = tv
+        start = 0
+        if req._grammar is not None:
+            try:
+                start = self._gtable.seat(req._grammar)
+                st.gkey = req._grammar.key
+            except GrammarError as e:
+                # seat-time pressure (table rows pinned by live
+                # requests): submit's budget check passed, so this is
+                # a transient-capacity edge. Never decode this slot
+                # unconstrained — cancel before its first step.
+                req.error = str(e)
+                req.cancel()
+                log_event(_log, "grammar_seat_failed", req_id=req.id,
+                          error=str(e))
+        st.gstate0 = int(start)
+        self._slot_gstate[slot] = start
+        self._dgstate = self._gstate_set()(
+            self._dgstate, jnp.int32(slot), jnp.int32(start)
+        )
+        st.stop_matcher = StopMatcher(req.stop) if req.stop else None
+        st.lp_out = [] if req.logprobs else None
+
+    def _clear_surface(self, slot: int, st: _SlotState) -> None:
+        """Retire-side inverse of ``_seat_surface``: drop the grammar
+        refcount and reset the host mirror rows to engine defaults.
+        The device FSM row is NOT rewritten — a stale state on an
+        inactive slot is inert (draws forced to 0, advance gated on
+        active) and the next occupant's seat overwrites it."""
+        if st.gkey is not None:
+            self._gtable.release(st.gkey)
+            st.gkey = None
+        self._slot_gstate[slot] = 0
+        self._slot_temps[slot] = self.temperature
+        self._slot_topks[slot] = int(self.top_k or 0)
+        self._slot_topps[slot] = 1.0
+        self._slot_bias_idx[slot] = -1
+        self._slot_bias_val[slot] = 0.0
+        if st.lp_out is not None:
+            st.req.logprobs_out = st.lp_out
 
     def _maybe_insert_prefix(self, pl: _AdmitPlan) -> None:
         """Insert-on-completion (of the prefill): cache the admitted
@@ -4117,13 +4712,16 @@ class ServingEngine:
         k = (1 if (self.adaptive_horizon and len(self.scheduler) > 0)
              else self.decode_horizon)
         self.decode_horizon_current = k
-        step_fn = self._step_fn_for(k)
+        surface = self._surface
+        step_fn = (self._masked_step_fn_for(k) if surface
+                   else self._step_fn_for(k))
         if fused is not None:
             fp = fused.plan
             ct0, cln, cb = fused.chunks[0]
             cpad = np.zeros((1, cb), np.int32)
             cpad[0, :cln] = fp.req.prompt[ct0:ct0 + cln]
-            pb_fn = self._piggyback_fn(cb, k)
+            pb_fn = (self._masked_piggyback_fn(cb, k) if surface
+                     else self._piggyback_fn(cb, k))
         attempt, backoff = 0, self.retry_backoff_s
         t_call = time.perf_counter()
         # .copy(): jnp.asarray can zero-copy alias the mutable host key
@@ -4133,6 +4731,15 @@ class ServingEngine:
         # what gets integrity-tracked until the readback.
         keys_host = self._slot_keys.copy()
         ad_host = self._slot_adapters.copy()
+        if surface:
+            # per-slot sampling-surface vectors, snapshotted for the
+            # same async-alias reason as the keys above
+            temps_host = self._slot_temps.copy()
+            topks_host = self._slot_topks.copy()
+            topps_host = self._slot_topps.copy()
+            bidx_host = self._slot_bias_idx.copy()
+            bval_host = self._slot_bias_val.copy()
+            mask_tab, trans_tab = self._grammar_device_tables()
         while True:
             try:
                 if self.faults is not None:
@@ -4141,7 +4748,7 @@ class ServingEngine:
                 # retire below releases the slot and rewrites its table
                 # row, so the paged table mirror must be rebuilt before
                 # every (re)dispatch
-                if fused is None:
+                if fused is None and not surface:
                     (caches, self._logits, self._dpos,
                      self._dactive, self._dbudget, toks) = step_fn(
                         self.params, self._caches_in(), self._logits,
@@ -4149,7 +4756,25 @@ class ServingEngine:
                         self._deos, jnp.asarray(keys_host),
                         jnp.asarray(ad_host),
                     )
-                else:
+                elif fused is None:
+                    # masked step: grammar FSM state threaded through
+                    # the substeps; ``toks`` is the packed aux block
+                    # (slots, K, 2+2*n_logprobs), token ids in [:,:,0]
+                    (caches, self._logits, self._dpos,
+                     self._dactive, self._dbudget, self._dgstate,
+                     toks) = step_fn(
+                        self.params, self._caches_in(), self._logits,
+                        self._dpos, self._dactive, self._dbudget,
+                        self._deos, self._dgstate,
+                        jnp.asarray(keys_host), jnp.asarray(ad_host),
+                        jnp.asarray(temps_host),
+                        jnp.asarray(topks_host),
+                        jnp.asarray(topps_host),
+                        jnp.asarray(bidx_host),
+                        jnp.asarray(bval_host),
+                        mask_tab, trans_tab,
+                    )
+                elif not surface:
                     # piggyback: K decode substeps + one bounded
                     # prefill chunk for the admitting slot, fused
                     (caches, self._logits, self._dpos,
@@ -4159,6 +4784,24 @@ class ServingEngine:
                         self._dpos, self._dactive, self._dbudget,
                         self._deos, jnp.asarray(keys_host),
                         jnp.asarray(ad_host), fused.tmp,
+                        jnp.asarray(cpad), jnp.int32(ct0),
+                        jnp.int32(cln - 1),
+                        jnp.asarray([fp.req.adapter], jnp.int32),
+                    )
+                else:
+                    (caches, self._logits, self._dpos,
+                     self._dactive, self._dbudget, self._dgstate,
+                     toks, fused.tmp, fused.lg) = pb_fn(
+                        self.params, self._caches_in(), self._logits,
+                        self._dpos, self._dactive, self._dbudget,
+                        self._deos, self._dgstate,
+                        jnp.asarray(keys_host), jnp.asarray(ad_host),
+                        jnp.asarray(temps_host),
+                        jnp.asarray(topks_host),
+                        jnp.asarray(topps_host),
+                        jnp.asarray(bidx_host),
+                        jnp.asarray(bval_host),
+                        mask_tab, trans_tab, fused.tmp,
                         jnp.asarray(cpad), jnp.int32(ct0),
                         jnp.int32(cln - 1),
                         jnp.asarray([fp.req.adapter], jnp.int32),
@@ -4225,6 +4868,8 @@ class ServingEngine:
             n_active=len(snaps),
         )
         fam = "step" if fused is None else "piggyback_step"
+        if surface:
+            fam = "masked_" + fam
         self._attr(("paged_" + fam) if self._paged else fam, t_call)
         if self.flight.enabled:
             self.flight.record(
@@ -4247,6 +4892,13 @@ class ServingEngine:
         re-acquired since dispatch are discarded."""
         t_sync = time.perf_counter()
         toks_host = np.asarray(horizon.toks)  # lint: sync-ok THE designated readback, 1/horizon
+        aux_host = None
+        if toks_host.ndim == 3:
+            # masked-step horizons read back the packed aux block:
+            # [:, :, 0] is the token stream, the rest carries bitcast
+            # logprob rows — still ONE readback per horizon
+            aux_host = toks_host
+            toks_host = aux_host[:, :, 0]
         if self._san is not None:
             # the program that read the dispatch-tracked buffers has
             # completed: verify nothing mutated them while in flight
@@ -4293,17 +4945,59 @@ class ServingEngine:
                             req.id, now - req.arrival_time
                         )
                 st.tokens.append(tok)
-                if req.stream is not None:
+                if st.lp_out is not None and aux_host is not None:
+                    row = aux_host[slot, k]
+                    nl = self._n_logprobs
+                    rec = {
+                        "token": tok,
+                        # contiguous row slice: bitcast back to f32
+                        "logprob": float(row[1:2].view(np.float32)[0]),  # lint: sync-ok row is a host numpy slice of aux_host, no device buffer
+                    }
+                    if req.top_logprobs:
+                        ids = row[2:2 + nl][:req.top_logprobs]
+                        vals = row[2 + nl:2 + 2 * nl].view(
+                            np.float32
+                        )[:req.top_logprobs]
+                        rec["top_logprobs"] = [
+                            {"token": int(i), "logprob": float(v)}  # lint: sync-ok host numpy scalars from aux_host
+                            for i, v in zip(ids, vals)
+                        ]
+                    st.lp_out.append(rec)
+                stopped = False
+                if st.stop_matcher is not None:
+                    emitted, stripped = st.stop_matcher.push(tok)
+                    if req.stream is not None:
+                        for et in emitted:
+                            req.stream.put(et)
+                    if stripped:
+                        # the matched stop sequence is NOT part of the
+                        # output: truncate the record (the held tokens
+                        # were never streamed)
+                        del st.tokens[-stripped:]
+                        if st.lp_out is not None:
+                            del st.lp_out[-stripped:]
+                        self.metrics.record_stop_hit()
+                        stopped = True
+                elif req.stream is not None:
                     # host-side fan-out for SSE: tokens already arrived
                     # with this horizon's one readback, so streaming
                     # costs zero extra device syncs
                     req.stream.put(tok)
+                if stopped:
+                    finished = True
+                    # the device mask did NOT freeze this slot (stops
+                    # are host-side): retire with deactivate below
+                    break
                 if (tok == req.eos_token
                         or len(st.tokens) >= req.max_new):
                     finished = True
                     break  # device mask froze this slot here too
             if finished:
-                self._finish(slot, now)
+                if stopped:
+                    self._retire(slot, RequestStatus.FINISHED, now,
+                                 deactivate=True)
+                else:
+                    self._finish(slot, now)
 
     def attach_sanitizer(self, san) -> None:
         """Attach an opt-in :class:`SyncSanitizer`: the engine stamps
@@ -4373,6 +5067,7 @@ class ServingEngine:
         self._dactive = jnp.zeros((self.n_slots,), bool)
         self._dbudget = jnp.zeros((self.n_slots,), jnp.int32)
         self._deos = jnp.full((self.n_slots,), _NO_EOS, jnp.int32)
+        self._dgstate = jnp.zeros((self.n_slots,), jnp.int32)
 
     def _probe_chunked_parity(self) -> bool:
         """One-time probe for ``chunked_replay="auto"``: does a
@@ -4517,9 +5212,25 @@ class ServingEngine:
         # for a temperature>0 stream to resume exactly where it left off
         self._slot_keys[:] = 0
         self._slot_adapters[:] = 0
+        if self._surface:
+            # sampling-surface mirrors share the keys' re-seat
+            # contract; the device grammar-table copies share the
+            # crash's blast radius, so force a refresh from the host
+            # table (which survived — it is plain numpy)
+            self._slot_gstate[:] = 0
+            self._slot_temps[:] = self.temperature
+            self._slot_topks[:] = int(self.top_k or 0)
+            self._slot_topps[:] = 1.0
+            self._slot_bias_idx[:] = -1
+            self._slot_bias_val[:] = 0.0
+            self._gtab_version = -1
         for slot, st in live:
             self._slot_keys[slot] = st.key_data
             self._slot_adapters[slot] = st.adapter
+            if self._surface:
+                self._reseat_surface(slot, st)
+        if self._surface and live:
+            self._dgstate = jnp.asarray(self._slot_gstate.copy())
         self.last_recover_mode = (
             None if not live else ("chunked" if chunked else "stepwise")
         )
@@ -4587,6 +5298,41 @@ class ServingEngine:
         self._deos = jnp.asarray(eos)
         self._log_recovered(t_rec, len(live))
         return len(live)
+
+    def _reseat_surface(self, slot: int, st: _SlotState) -> None:
+        """Crash-recovery re-seat of one live slot's sampling-surface
+        state (mirrors the adapter/key re-seat): per-slot sampler
+        vectors from the request, the grammar FSM state re-walked over
+        the recorded tokens from the seat state, and the stop-sequence
+        hold-back rebuilt by re-pushing the stream (a live slot's
+        record cannot contain a completed stop match, so the rebuild
+        emits nothing we'd have to suppress — emissions are simply
+        discarded, they already streamed before the crash)."""
+        req = st.req
+        self._slot_temps[slot] = np.float32(
+            req.temperature if req.temperature is not None
+            else self.temperature
+        )
+        self._slot_topks[slot] = np.int32(
+            req.top_k if req.top_k is not None else (self.top_k or 0)
+        )
+        self._slot_topps[slot] = np.float32(
+            req.top_p if req.top_p is not None else 1.0
+        )
+        self._slot_bias_idx[slot] = -1
+        self._slot_bias_val[slot] = 0.0
+        if req.logit_bias:
+            for j, (ti, tv) in enumerate(sorted(req.logit_bias.items())):
+                self._slot_bias_idx[slot, j] = ti
+                self._slot_bias_val[slot, j] = tv
+        g = int(st.gstate0)
+        for t in st.tokens:
+            g = self._gtable.advance(g, int(t))
+        self._slot_gstate[slot] = g
+        if st.stop_matcher is not None:
+            st.stop_matcher = StopMatcher(req.stop)
+            for t in st.tokens:
+                st.stop_matcher.push(int(t))
 
     def _log_recovered(self, t_rec: float, n_replayed: int) -> None:
         now = time.perf_counter()

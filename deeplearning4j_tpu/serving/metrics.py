@@ -394,6 +394,17 @@ class ServingMetrics:
             "serve_migration_seat_seconds",
             "One migrated session seat: validate + device insert.",
         )
+        self._c_grammar_compiles = reg.counter(
+            "serve_grammar_compiles_total",
+            "Grammar constraint resolutions at submit, by result "
+            "(hit = LRU/disk cache, miss = fresh DFA compile, "
+            "error = rejected 400).", ("result",),
+        )
+        self._c_stop_hits = reg.counter(
+            "serve_stop_hits_total",
+            "Requests finished by a stop-sequence match (host-side "
+            "suffix match at readback).",
+        )
         self._c_prog_seconds = reg.counter(
             "serve_program_seconds_total",
             "Wall seconds attributed to compiled program families at "
@@ -546,6 +557,14 @@ class ServingMetrics:
         """One submit shed at max queue depth."""
         self.n_backpressure += 1
         self._c_backpressure.inc()
+
+    def record_grammar_compile(self, result: str) -> None:
+        """One grammar constraint resolution (hit|miss|error)."""
+        self._c_grammar_compiles.inc(result=result)
+
+    def record_stop_hit(self) -> None:
+        """One request finished by a stop-sequence match."""
+        self._c_stop_hits.inc()
 
     def record_rejection(self, reason: str, tenant: str = "") -> None:
         """One submit shed before queueing, with its reason

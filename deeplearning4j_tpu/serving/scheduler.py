@@ -41,6 +41,14 @@ from collections import deque
 import numpy as np
 
 from deeplearning4j_tpu.analysis.sanitizers import note_access, wrap_lock
+from deeplearning4j_tpu.serving.grammar import (
+    MAX_LOGIT_BIAS,
+    MAX_STOP_LEN,
+    MAX_STOP_SEQUENCES,
+    MAX_TOP_LOGPROBS,
+    GrammarError,
+    parse_response_format,
+)
 
 
 class RequestStatus(str, enum.Enum):
@@ -90,6 +98,16 @@ class Request:
     base model). ``stream`` (optional ``queue.Queue``) receives each
     generated token as it arrives host-side, then ``None`` as the
     end-of-stream sentinel — the SSE front end drains it.
+
+    Sampling-surface fields (engines built with
+    ``sampling_surface=True``; see serving.grammar): ``temperature`` /
+    ``top_k`` / ``top_p`` override the engine-wide sampler per request
+    (None = engine default); ``stop`` is a list of token-id sequences
+    matched host-side at readback (the match is stripped from the
+    stream); ``logit_bias`` maps token id -> additive logit value;
+    ``logprobs`` requests per-token logprobs and ``top_logprobs`` the
+    per-position top-k alternatives; ``response_format`` constrains
+    output to a regex or JSON schema (token-level DFA mask).
     """
 
     prompt: np.ndarray
@@ -100,6 +118,22 @@ class Request:
     tenant_id: str = ""
     adapter: int = 0
     stream: queue_mod.Queue | None = None
+    temperature: float | None = None
+    top_k: int | None = None
+    top_p: float | None = None
+    stop: list | None = None
+    logit_bias: dict | None = None
+    logprobs: bool = False
+    top_logprobs: int = 0
+    response_format: dict | str | None = None
+    # resolved by the engine at submit/retire: the compiled grammar
+    # (serving.grammar.CompiledGrammar) and per-token logprob records
+    _grammar: object = dataclasses.field(
+        default=None, repr=False, compare=False,
+    )
+    logprobs_out: list | None = dataclasses.field(
+        default=None, repr=False, compare=False,
+    )
     # distributed-tracing context (W3C traceparent, see obs.trace):
     # resolved/generated by the HTTP front end, carried so the engine's
     # admission span and the JSON logs join the fleet-wide trace.
@@ -133,6 +167,80 @@ class Request:
             raise AdmissionError(
                 f"adapter must be >= 0, got {self.adapter}"
             )
+        if self.temperature is not None and self.temperature < 0:
+            raise AdmissionError(
+                f"temperature must be >= 0, got {self.temperature}"
+            )
+        if self.top_k is not None and self.top_k < 1:
+            raise AdmissionError(
+                f"top_k must be >= 1, got {self.top_k}"
+            )
+        if self.top_p is not None and not (0.0 < self.top_p <= 1.0):
+            raise AdmissionError(
+                f"top_p must be in (0, 1], got {self.top_p}"
+            )
+        if self.stop is not None:
+            self.stop = [
+                [int(t) for t in np.asarray(s).reshape(-1)]
+                for s in self.stop
+            ]
+            if len(self.stop) > MAX_STOP_SEQUENCES:
+                raise AdmissionError(
+                    f"at most {MAX_STOP_SEQUENCES} stop sequences, "
+                    f"got {len(self.stop)}"
+                )
+            for s in self.stop:
+                if not 1 <= len(s) <= MAX_STOP_LEN:
+                    raise AdmissionError(
+                        f"stop sequences must be 1..{MAX_STOP_LEN} "
+                        f"tokens, got {len(s)}"
+                    )
+        if self.logit_bias is not None:
+            try:
+                self.logit_bias = {
+                    int(k): float(v) for k, v in self.logit_bias.items()
+                }
+            except (TypeError, ValueError, AttributeError):
+                raise AdmissionError(
+                    "logit_bias must map token ids to numbers"
+                ) from None
+            if len(self.logit_bias) > MAX_LOGIT_BIAS:
+                raise AdmissionError(
+                    f"at most {MAX_LOGIT_BIAS} logit_bias entries, "
+                    f"got {len(self.logit_bias)}"
+                )
+            if any(k < 0 for k in self.logit_bias):
+                raise AdmissionError("logit_bias token ids must be >= 0")
+        if not 0 <= int(self.top_logprobs) <= MAX_TOP_LOGPROBS:
+            raise AdmissionError(
+                f"top_logprobs must be 0..{MAX_TOP_LOGPROBS}, got "
+                f"{self.top_logprobs}"
+            )
+        self.top_logprobs = int(self.top_logprobs)
+        if self.top_logprobs:
+            self.logprobs = True
+        if self.response_format is not None:
+            try:
+                parse_response_format(self.response_format)
+            except GrammarError as e:
+                raise AdmissionError(
+                    f"bad response_format: {e}"
+                ) from None
+
+    @property
+    def uses_sampling_surface(self) -> bool:
+        """Any per-request sampling-surface field set? Such requests
+        must decode through the engine's masked step family (engines
+        without ``sampling_surface=True`` reject them at submit)."""
+        return (
+            self.temperature is not None
+            or self.top_k is not None
+            or self.top_p is not None
+            or bool(self.stop)
+            or bool(self.logit_bias)
+            or self.logprobs
+            or self.response_format is not None
+        )
 
     def token_cost(self) -> int:
         """Service cost in tokens — the unit the DRR tier and the

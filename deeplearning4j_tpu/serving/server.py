@@ -30,8 +30,16 @@ Endpoints:
 
 - ``POST /v1/generate`` — body ``{"prompt": [ints] | "text",
   "max_new": int, "priority"?: int, "eos_token"?: int,
-  "deadline_s"?: float, "adapter"?: int, "stream"?: bool}``; returns
-  ``{"id", "tokens", "text"?, "timing"?}`` where ``timing`` is
+  "deadline_s"?: float, "adapter"?: int, "stream"?: bool}`` plus —
+  on engines built with ``sampling_surface=True`` — the per-request
+  sampling surface: ``"temperature"?: float, "top_k"?: int,
+  "top_p"?: float, "stop"?: str | [str | [ints]],
+  "logit_bias"?: {token_id: float}, "logprobs"?: bool,
+  "top_logprobs"?: int, "response_format"?: {"type": "json_schema",
+  "json_schema": {...}} | {"type": "regex", "regex": "..."}``
+  (grammar-constrained decoding; requires ``eos_token``). Returns
+  ``{"id", "tokens", "text"?, "timing"?, "logprobs"?}`` where
+  ``timing`` is
   ``{"ttft_s", "decode_s"}`` — engine-local time to first token and
   wall time after it (end-to-end TTFT = request wall - ``decode_s``,
   which counts queueing and any disagg prefill/transfer leg). 429 on
@@ -442,11 +450,45 @@ class ServingServer:
             prompt = list(prompt.encode("latin-1", errors="replace"))
         if not isinstance(prompt, list):
             raise ValueError("'prompt' must be a token list or a string")
+        stop = body.get("stop")
+        if stop is not None:
+            if isinstance(stop, str):
+                stop = [stop]
+            if not isinstance(stop, list):
+                raise ValueError(
+                    "'stop' must be a string or a list of "
+                    "strings/token lists"
+                )
+            stops = []
+            for s in stop:
+                if isinstance(s, str):
+                    if not self._byte_vocab():
+                        raise ValueError(
+                            "string stop sequences need a byte-level "
+                            "model (vocab <= 256)"
+                        )
+                    s = list(s.encode("latin-1", errors="replace"))
+                if not isinstance(s, list) or not s:
+                    raise ValueError(
+                        "each stop sequence must be a non-empty "
+                        "string or token list"
+                    )
+                stops.append([int(t) for t in s])
+            stop = stops
         # the tenant supplies scheduling priority and the LoRA adapter
         # unless the body names its own
         return Request(
             prompt=prompt,
             max_new=int(body.get("max_new", 16)),
+            temperature=(float(body["temperature"])
+                         if "temperature" in body else None),
+            top_k=int(body["top_k"]) if "top_k" in body else None,
+            top_p=float(body["top_p"]) if "top_p" in body else None,
+            stop=stop,
+            logit_bias=body.get("logit_bias"),
+            logprobs=bool(body.get("logprobs", False)),
+            top_logprobs=int(body.get("top_logprobs", 0)),
+            response_format=body.get("response_format"),
             priority=int(body.get(
                 "priority", tenant.priority if tenant is not None else 1
             )),
@@ -568,6 +610,8 @@ class ServingServer:
         if timing is not None:
             out["timing"] = {k: round(float(v), 6)
                              for k, v in timing.items()}
+        if req.logprobs and req.logprobs_out is not None:
+            out["logprobs"] = req.logprobs_out
         if self._byte_vocab():
             out["text"] = bytes(
                 t % 256 for t in toks
@@ -629,6 +673,10 @@ class ServingServer:
                      "n_tokens": n, "done": True}
             if req.status is not RequestStatus.FINISHED and req.error:
                 final["error"] = req.error
+            if req.logprobs and req.logprobs_out is not None:
+                # per-token logprobs ride the final frame (the engine
+                # attaches them at retire, before the sentinel)
+                final["logprobs"] = req.logprobs_out
             self._sse(handler, final)
             log_event(_log, "request_completed", req_id=req.id, http=200,
                       status=req.status.value, n_tokens=n, stream=True,
